@@ -1,0 +1,26 @@
+"""fast-autoaugment-tpu: a TPU-native Fast AutoAugment framework.
+
+Brand-new JAX/XLA/Flax implementation of the capabilities of
+kakaobrain/fast-autoaugment (NeurIPS 2019): augmentation-policy search
+by density matching, plus full training of WideResNet / ResNet /
+Shake-Shake / PyramidNet+ShakeDrop / EfficientNet(+CondConv) on
+CIFAR-10/100, SVHN and ImageNet — re-designed TPU-first rather than
+translated from the PyTorch/CUDA/Ray reference.
+
+Layering (see SURVEY.md section 7):
+
+- ``core``     config, metrics, checkpointing
+- ``ops``      on-device augmentation kernels, stochastic shake ops,
+               optimizers, LR schedules
+- ``policies`` found-policy archives (data) + codec
+- ``data``     host input pipeline (native dataset readers, folds,
+               device prefetch)
+- ``models``   Flax model zoo + registry
+- ``parallel`` mesh / sharding / collective helpers
+- ``train``    jitted train/eval steps + epoch driver
+- ``search``   density-matching policy search (in-tree TPE + batched
+               TTA evaluation)
+- ``launch``   CLI entry points and multi-host launching
+"""
+
+__version__ = "0.1.0"

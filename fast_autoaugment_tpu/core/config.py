@@ -1,0 +1,169 @@
+"""Immutable configuration objects.
+
+The reference uses the ``theconf`` package: a process-global mutable
+``Config.get()`` singleton readable from any module (reference
+``train.py:20``, ``data.py:53``) merged from a YAML file plus CLI
+overrides.  A mutable global is hostile to jit tracing and to running
+many differently-configured trials inside one process (the search loop
+mutates copies of the config dict per trial, reference
+``search.py:62-64``), so here configuration is an explicit, immutable,
+hashable object passed to the functions that need it.
+
+- :class:`Config` wraps a nested dict; attribute and item access;
+  ``cfg.replace(**dotted)`` returns a new config.
+- :func:`load_config` reads a YAML preset (same schema as the reference
+  ``confs/*.yaml``) and applies dotted-path CLI overrides.
+
+Hashability means a ``Config`` can be a static argument to
+``jax.jit``-compiled functions without further ceremony.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator, Mapping
+
+import yaml
+
+__all__ = ["Config", "load_config", "parse_overrides"]
+
+
+def _freeze(value: Any) -> Any:
+    if isinstance(value, Mapping):
+        return Config(value)
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    return value
+
+
+def _thaw(value: Any) -> Any:
+    if isinstance(value, Config):
+        return {k: _thaw(v) for k, v in value.items()}
+    if isinstance(value, tuple):
+        return [_thaw(v) for v in value]
+    return value
+
+
+class Config(Mapping):
+    """Immutable nested mapping with attribute access.
+
+    >>> c = Config({'model': {'type': 'wresnet40_2'}, 'lr': 0.1})
+    >>> c.model.type
+    'wresnet40_2'
+    >>> c['lr']
+    0.1
+    >>> c.get('missing', 3)
+    3
+    >>> c2 = c.replace(**{'model.type': 'resnet50'})
+    >>> c2.model.type, c.model.type
+    ('resnet50', 'wresnet40_2')
+    """
+
+    __slots__ = ("_data", "_hash")
+
+    def __init__(self, data: Mapping | None = None):
+        object.__setattr__(self, "_data", {k: _freeze(v) for k, v in (data or {}).items()})
+        object.__setattr__(self, "_hash", None)
+
+    # Mapping protocol -------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._data[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._data
+
+    # Attribute access -------------------------------------------------
+    def __getattr__(self, key: str) -> Any:
+        try:
+            return self._data[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def __setattr__(self, key: str, value: Any):
+        raise TypeError("Config is immutable; use .replace()")
+
+    # Niceties ---------------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        """Dotted-path lookup with default: ``cfg.get('optimizer.clip', 5.0)``."""
+        node: Any = self
+        for part in key.split("."):
+            if isinstance(node, Config) and part in node:
+                node = node[part]
+            else:
+                return default
+        return node
+
+    def to_dict(self) -> dict:
+        return {k: _thaw(v) for k, v in self._data.items()}
+
+    def replace(self, **dotted: Any) -> "Config":
+        """Return a new Config with dotted-path keys replaced.
+
+        Underscores may be used in place of dots only if the key has no
+        dots (plain top-level keys).
+        """
+        data = self.to_dict()
+        for path, value in dotted.items():
+            node = data
+            parts = path.split(".")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = value
+        return Config(data)
+
+    def __hash__(self) -> int:
+        if self._hash is None:
+            object.__setattr__(
+                self, "_hash", hash(json.dumps(self.to_dict(), sort_keys=True, default=str))
+            )
+        return self._hash
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Config) and self.to_dict() == other.to_dict()
+
+    def __repr__(self) -> str:
+        return f"Config({self.to_dict()!r})"
+
+
+def _coerce(text: str) -> Any:
+    """Parse a CLI override value with YAML scalar rules ('5' -> 5 etc.)."""
+    try:
+        return yaml.safe_load(text)
+    except yaml.YAMLError:
+        return text
+
+
+def parse_overrides(pairs: list[str]) -> dict:
+    """Parse ``["model.type=resnet50", "lr=0.4"]`` into a dotted dict."""
+    out: dict[str, Any] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"override must look like key=value, got {pair!r}")
+        key, _, value = pair.partition("=")
+        out[key.strip()] = _coerce(value.strip())
+    return out
+
+
+def load_config(path: str | None = None, overrides: list[str] | dict | None = None,
+                defaults: Mapping | None = None) -> Config:
+    """Load a YAML preset and apply dotted CLI overrides.
+
+    Mirrors the reference's ``ConfigArgumentParser`` behavior (YAML via
+    ``-c`` + CLI flags override file values) without the global singleton.
+    """
+    data: dict = dict(defaults or {})
+    if path is not None:
+        with open(path) as fh:
+            data.update(yaml.safe_load(fh) or {})
+    cfg = Config(data)
+    if overrides:
+        if isinstance(overrides, list):
+            overrides = parse_overrides(overrides)
+        cfg = cfg.replace(**overrides)
+    return cfg

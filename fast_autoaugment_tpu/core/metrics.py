@@ -1,0 +1,133 @@
+"""Losses and metric accumulation as pure functions / pytrees.
+
+TPU-native re-design of the reference's ``metrics.py`` and
+``aug_mixup.py``:
+
+- label-smoothed cross entropy (reference ``metrics.py:26-46``) and its
+  mixup variant (reference ``aug_mixup.py:26-32``) as pure jnp functions
+  usable inside a jitted train step;
+- batch mixup with lam ~ Beta(alpha, alpha), lam <- max(lam, 1-lam)
+  (reference ``aug_mixup.py:13-23``) done on-device;
+- top-k accuracy (reference ``metrics.py:10-23``);
+- :class:`Accumulator` (reference ``metrics.py:49-85``): count-weighted
+  sums normalized by total sample count.  Here it is a plain dict pytree
+  so a sharded eval loop can ``jax.tree.map``-add jnp scalars without
+  host sync, then ``normalize()`` once at the end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "cross_entropy",
+    "smooth_cross_entropy",
+    "mixup_batch",
+    "mixup_cross_entropy",
+    "top_k_correct",
+    "accuracy",
+    "Accumulator",
+]
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array, reduce_mean: bool = True) -> jax.Array:
+    """Plain softmax cross entropy with integer labels."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return nll.mean() if reduce_mean else nll
+
+
+def smooth_cross_entropy(logits: jax.Array, labels: jax.Array, epsilon: float = 0.0,
+                         reduce_mean: bool = True) -> jax.Array:
+    """Label-smoothed cross entropy.
+
+    Matches ``CrossEntropyLabelSmooth`` (reference ``metrics.py:26-46``):
+    targets = (1 - eps) * onehot + eps / num_classes.
+    """
+    if not epsilon:
+        return cross_entropy(logits, labels, reduce_mean)
+    num_classes = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
+    targets = (1.0 - epsilon) * onehot + epsilon / num_classes
+    nll = -(targets * logp).sum(axis=-1)
+    return nll.mean() if reduce_mean else nll
+
+
+def mixup_batch(key: jax.Array, images: jax.Array, labels: jax.Array, alpha: float):
+    """Mix a batch with itself under a random permutation.
+
+    Reference ``aug_mixup.py:13-23``: lam ~ Beta(alpha, alpha) (a single
+    scalar per batch), then lam <- max(lam, 1 - lam) so the original
+    image always dominates.  Returns (mixed_images, labels_a, labels_b, lam).
+    """
+    key_lam, key_perm = jax.random.split(key)
+    lam = jax.random.beta(key_lam, alpha, alpha) if alpha > 0 else jnp.float32(1.0)
+    lam = jnp.maximum(lam, 1.0 - lam)
+    perm = jax.random.permutation(key_perm, images.shape[0])
+    mixed = lam * images + (1.0 - lam) * images[perm]
+    return mixed, labels, labels[perm], lam
+
+
+def mixup_cross_entropy(logits, labels_a, labels_b, lam, epsilon: float = 0.0):
+    """lam * CE(a) + (1 - lam) * CE(b) (reference ``aug_mixup.py:26-32``)."""
+    loss_a = smooth_cross_entropy(logits, labels_a, epsilon)
+    loss_b = smooth_cross_entropy(logits, labels_b, epsilon)
+    return lam * loss_a + (1.0 - lam) * loss_b
+
+
+def top_k_correct(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
+    """Number of samples whose true label is in the top-k logits."""
+    _, topk = jax.lax.top_k(logits, k)
+    return (topk == labels[:, None]).any(axis=-1).sum()
+
+
+def accuracy(logits: jax.Array, labels: jax.Array, topk=(1,)):
+    """Top-k accuracies as fractions (reference ``metrics.py:10-23``)."""
+    n = logits.shape[0]
+    return tuple(top_k_correct(logits, labels, k) / n for k in topk)
+
+
+class Accumulator:
+    """Count-weighted metric sums (reference ``metrics.py:49-85``).
+
+    ``add_dict`` accumulates raw sums (caller pre-multiplies per-batch
+    means by the batch size, as the reference does at ``train.py:73-78``);
+    ``normalize()`` divides everything except the counter key by the
+    total count.  Values may be python floats or jnp scalars — they are
+    only forced to host floats at ``normalize``/``__getitem__`` time so
+    the device is never stalled mid-epoch.
+    """
+
+    def __init__(self):
+        self.metrics: dict = {}
+
+    def add(self, key: str, value):
+        self.metrics[key] = self.metrics.get(key, 0.0) + value
+
+    def add_dict(self, d: dict):
+        for k, v in d.items():
+            self.add(k, v)
+
+    def __getitem__(self, key: str) -> float:
+        return float(self.metrics.get(key, 0.0))
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.metrics
+
+    def items(self):
+        return self.metrics.items()
+
+    def normalize(self, count_key: str = "num") -> dict:
+        count = float(self.metrics.get(count_key, 0.0))
+        out = {}
+        for k, v in self.metrics.items():
+            if k == count_key:
+                out[k] = count
+            else:
+                out[k] = float(v) / count if count else 0.0
+        return out
+
+    def __repr__(self):
+        return f"Accumulator({ {k: float(v) for k, v in self.metrics.items()} })"

@@ -1,0 +1,92 @@
+"""Logging and scalar-metric writers.
+
+Replaces the reference's ``common.py:10-25`` logger helpers and its
+tensorboardX writer trio (reference ``train.py:176-181``) with a
+dependency-free scalar writer that appends JSONL events; a no-op writer
+stands in on non-master hosts (the analog of ``SummaryWriterDummy``,
+reference ``metrics.py:88-93``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+__all__ = ["get_logger", "add_filehandler", "ScalarWriter", "NullWriter", "make_writers"]
+
+_FORMAT = "[%(asctime)s] [%(name)s] [%(levelname)s] %(message)s"
+
+
+def get_logger(name: str, level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+def add_filehandler(logger: logging.Logger, path: str, level: int = logging.DEBUG):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.setLevel(level)
+    logger.addHandler(handler)
+
+
+class ScalarWriter:
+    """Append-only JSONL scalar log: one event per line.
+
+    ``{"tag": "loss", "value": 1.2, "step": 10, "wall": 169...}``.
+    Readable incrementally by external tooling; no tensorboard
+    dependency required on the TPU host.
+    """
+
+    def __init__(self, logdir: str, name: str):
+        os.makedirs(logdir, exist_ok=True)
+        self._path = os.path.join(logdir, f"{name}.jsonl")
+        self._fh = open(self._path, "a", buffering=1)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def add_scalar(self, tag: str, value, step: int):
+        self._fh.write(
+            json.dumps({"tag": tag, "value": float(value), "step": int(step),
+                        "wall": time.time()})
+            + "\n"
+        )
+
+    def flush(self):
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+
+class NullWriter:
+    """No-op writer for non-master processes."""
+
+    path = None
+
+    def add_scalar(self, tag: str, value, step: int):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def make_writers(logdir: str | None, tag: str, is_master: bool):
+    """Build (train, valid, test) writers; no-ops off-master or without logdir."""
+    if not is_master or not logdir:
+        return NullWriter(), NullWriter(), NullWriter()
+    return tuple(ScalarWriter(logdir, f"{tag}_{split}") for split in ("train", "valid", "test"))

@@ -1,0 +1,11 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="fast-autoaugment-tpu",
+    version="0.1.0",
+    description="TPU-native Fast AutoAugment: policy search by density matching in JAX/Flax",
+    packages=find_packages(include=["fast_autoaugment_tpu*"]),
+    package_data={"fast_autoaugment_tpu.policies": ["data/*.json"]},
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "numpy", "pyyaml", "msgpack"],
+)

@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+Forces JAX onto the CPU backend with 8 virtual devices BEFORE jax
+initializes, so every test exercises real multi-device semantics
+(pjit/shard_map over a Mesh) without TPU hardware.  The reference had
+no equivalent (its cluster paths were only testable by running the
+cluster, SURVEY.md section 4); this is the TPU-native answer.
+"""
+
+import os
+
+# Must run before `import jax` anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)

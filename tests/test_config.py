@@ -1,0 +1,60 @@
+import os
+import tempfile
+
+import pytest
+
+from fast_autoaugment_tpu.core.config import Config, load_config, parse_overrides
+
+
+def test_attribute_and_item_access():
+    c = Config({"model": {"type": "wresnet40_2", "depth": 40}, "lr": 0.1})
+    assert c.model.type == "wresnet40_2"
+    assert c["lr"] == 0.1
+    assert c.get("model.depth") == 40
+    assert c.get("optimizer.clip", 5.0) == 5.0
+
+
+def test_immutable_and_hashable():
+    c = Config({"a": {"b": 1}})
+    with pytest.raises(TypeError):
+        c.x = 1
+    assert hash(c) == hash(Config({"a": {"b": 1}}))
+    d = {c: "ok"}
+    assert d[Config({"a": {"b": 1}})] == "ok"
+
+
+def test_replace_returns_new():
+    c = Config({"model": {"type": "wrn"}, "lr": 0.1})
+    c2 = c.replace(**{"model.type": "resnet50", "epoch": 90})
+    assert c2.model.type == "resnet50" and c2.epoch == 90
+    assert c.model.type == "wrn" and "epoch" not in c
+
+
+def test_load_yaml_with_overrides():
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as fh:
+        fh.write("model:\n  type: wresnet40_2\nbatch: 128\nlr: 0.1\n")
+        path = fh.name
+    try:
+        cfg = load_config(path, overrides=["lr=0.4", "model.type=resnet50"])
+        assert cfg.batch == 128
+        assert cfg.lr == 0.4  # coerced to float
+        assert cfg.model.type == "resnet50"
+    finally:
+        os.unlink(path)
+
+
+def test_parse_overrides_yaml_coercion():
+    out = parse_overrides(["a=5", "b=true", "c=hello", "d=[1,2]"])
+    assert out == {"a": 5, "b": True, "c": "hello", "d": [1, 2]}
+
+
+def test_accumulator():
+    from fast_autoaugment_tpu.core.metrics import Accumulator
+
+    acc = Accumulator()
+    acc.add_dict({"loss": 2.0 * 4, "top1": 3.0, "num": 4})
+    acc.add_dict({"loss": 1.0 * 4, "top1": 4.0, "num": 4})
+    norm = acc.normalize()
+    assert norm["num"] == 8
+    assert norm["loss"] == pytest.approx(1.5)
+    assert norm["top1"] == pytest.approx(7 / 8)
