@@ -1,0 +1,93 @@
+"""Headline benchmark: WRN-40-2 CIFAR-10 training throughput per chip.
+
+Measures the full production train step — on-device fa_reduced_cifar10
+policy augmentation (493 sub-policies as a tensor), random crop/flip,
+normalize, cutout-16, forward/backward with global-batch BN, non-BN
+weight decay, grad clip, SGD-nesterov, cosine+warmup LR — at the
+reference's headline config (``confs/wresnet40x2_cifar.yaml``: batch
+128 per device).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference pipeline (PyTorch + 8 PIL CPU workers per GPU)
+sustains roughly 1500 images/s/GPU on a V100-class device for WRN-40-2
+CIFAR-10 (its 3.5 GPU-hour / 200-epoch budget on this config implies
+the low thousands; no exact number is published — README.md:16).
+vs_baseline = value / 1500.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_IMAGES_PER_SEC = 1500.0
+BATCH_PER_DEVICE = 128
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fast_autoaugment_tpu.models import get_model
+    from fast_autoaugment_tpu.ops.optim import build_optimizer
+    from fast_autoaugment_tpu.ops.schedules import build_schedule
+    from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_batch
+    from fast_autoaugment_tpu.policies.archive import load_policy, policy_to_tensor
+    from fast_autoaugment_tpu.train.steps import create_train_state, make_train_step
+
+    mesh = make_mesh()
+    n_dev = mesh.size
+    global_batch = BATCH_PER_DEVICE * n_dev
+
+    conf = {
+        "lr": 0.1, "epoch": 200,
+        "lr_schedule": {"type": "cosine", "warmup": {"multiplier": 2, "epoch": 5}},
+    }
+    model = get_model({"type": "wresnet40_2"}, 10)
+    optimizer = build_optimizer(
+        {"type": "sgd", "decay": 2e-4, "clip": 5.0, "momentum": 0.9, "nesterov": True},
+        build_schedule(conf, steps_per_epoch=50000 // global_batch,
+                       world_lr_scale=float(n_dev)),
+    )
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    state = create_train_state(model, optimizer, rng, sample, use_ema=False)
+    train_step = make_train_step(
+        model, optimizer, num_classes=10, cutout_length=16, use_policy=True
+    )
+
+    policy = jnp.asarray(policy_to_tensor(load_policy("fa_reduced_cifar10")))
+    images = np.random.default_rng(0).integers(
+        0, 256, (global_batch, 32, 32, 3), dtype=np.uint8
+    )
+    labels = np.random.default_rng(1).integers(0, 10, (global_batch,), np.int32)
+    batch = shard_batch(mesh, {"x": images, "y": labels})
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = train_step(state, batch["x"], batch["y"], policy, rng)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = train_step(state, batch["x"], batch["y"], policy, rng)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec_per_chip = MEASURE_STEPS * global_batch / dt / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "wrn40x2_cifar10_train_images_per_sec_per_chip",
+                "value": round(images_per_sec_per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(images_per_sec_per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

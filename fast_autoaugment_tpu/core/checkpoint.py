@@ -1,0 +1,68 @@
+"""Checkpointing with cheap, separately-readable step metadata.
+
+The reference stores one pickled dict ``{epoch, log{...}, optimizer,
+model, ema}`` via ``torch.save`` (``train.py:305-317``) — and then its
+search driver POLLS those checkpoints every 10 s just to read
+``ckpt['epoch']``, deserializing full model weights each time
+(``search.py:186-190``).  Here the tensor payload is a msgpack of the
+state pytree (flax serialization) and the metadata is a tiny JSON
+sidecar, so progress polling never touches tensor bytes
+(SURVEY.md section 5, checkpoint/resume).
+
+Writes are atomic (tmp + rename) so a concurrently-polling reader never
+sees a torn file — the reference guards this with bare ``except``
+retries instead (``search.py:191-192``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from flax import serialization
+
+__all__ = ["save_checkpoint", "load_checkpoint", "read_metadata", "checkpoint_exists"]
+
+
+def _meta_path(path: str) -> str:
+    return path + ".meta.json"
+
+
+def save_checkpoint(path: str, state: Any, metadata: dict | None = None):
+    """Serialize `state` (any pytree) to `path` atomically; write the
+    JSON `metadata` sidecar after the payload is in place."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    payload = serialization.to_bytes(state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(payload)
+    os.replace(tmp, path)
+    meta = dict(metadata or {})
+    tmp_meta = _meta_path(path) + ".tmp"
+    with open(tmp_meta, "w") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp_meta, _meta_path(path))
+
+
+def load_checkpoint(path: str, target: Any) -> Any:
+    """Restore a pytree of the same structure as `target` from `path`."""
+    with open(path, "rb") as fh:
+        return serialization.from_bytes(target, fh.read())
+
+
+def read_metadata(path: str) -> dict | None:
+    """Read the metadata sidecar without touching tensor bytes.
+
+    Returns None if the checkpoint (or sidecar) does not exist yet —
+    callers poll this during search phase 1.
+    """
+    try:
+        with open(_meta_path(path)) as fh:
+            return json.load(fh)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def checkpoint_exists(path: str) -> bool:
+    return os.path.exists(path) and os.path.exists(_meta_path(path))

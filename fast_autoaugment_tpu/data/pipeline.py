@@ -1,0 +1,144 @@
+"""Host-side batching and device feed.
+
+Replaces the reference's torch DataLoader pool (8 worker processes per
+GPU doing PIL augmentation, ``data.py:214-224``).  Because augmentation
+runs on device here, the host work is only: shuffle indices, slice
+uint8 arrays, (for lazy datasets) decode JPEGs, and hand batches to the
+mesh.  JAX's async dispatch overlaps the next batch's host work with
+the current step's device work; an optional background thread deepens
+the pipeline to keep the TPU fed.
+
+Semantics parity (``data.py:205-224``): the train iterator reshuffles
+every epoch from a deterministic per-epoch seed (the analog of
+``DistributedSampler.set_epoch``, ``train.py:251-252``), drops the last
+partial batch (``drop_last=True``), and in multi-host mode each process
+takes its own contiguous shard of every global batch.  Valid/test
+iterate deterministically without dropping.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from fast_autoaugment_tpu.data.datasets import ArrayDataset
+
+__all__ = ["BatchIterator", "train_batches", "eval_batches", "prefetch"]
+
+
+def _decode(paths: np.ndarray, size: int | None) -> np.ndarray:
+    """Decode a batch of image files to uint8 NHWC (lazy datasets)."""
+    import PIL.Image
+
+    out = []
+    for p in paths:
+        img = PIL.Image.open(p).convert("RGB")
+        if size is not None:
+            img = img.resize((size, size), PIL.Image.BICUBIC)
+        out.append(np.asarray(img, np.uint8))
+    return np.stack(out)
+
+
+def train_batches(
+    dataset: ArrayDataset,
+    indices: np.ndarray | None,
+    global_batch: int,
+    epoch: int,
+    *,
+    seed: int = 0,
+    process_index: int = 0,
+    process_count: int = 1,
+    decode_size: int | None = None,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Shuffled, drop-last train batches for one epoch.
+
+    `indices` restricts to a subset (CV fold); each process yields its
+    [process_index] shard of every global batch, so all hosts stay in
+    step for the pjit'd global-batch train step.
+    """
+    idx = np.arange(len(dataset)) if indices is None else np.asarray(indices)
+    rng = np.random.default_rng((seed, epoch))
+    idx = rng.permutation(idx)
+    steps = len(idx) // global_batch
+    shard = global_batch // process_count
+    for s in range(steps):
+        chunk = idx[s * global_batch:(s + 1) * global_batch]
+        chunk = chunk[process_index * shard:(process_index + 1) * shard]
+        images = dataset.images[chunk]
+        if dataset.lazy:
+            images = _decode(images, decode_size)
+        yield images, dataset.labels[chunk]
+
+
+def eval_batches(
+    dataset: ArrayDataset,
+    indices: np.ndarray | None,
+    batch: int,
+    *,
+    decode_size: int | None = None,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Deterministic eval batches (SubsetSampler semantics,
+    ``data.py:348-362``); final partial batch kept."""
+    idx = np.arange(len(dataset)) if indices is None else np.asarray(indices)
+    for s in range(0, len(idx), batch):
+        chunk = idx[s:s + batch]
+        images = dataset.images[chunk]
+        if dataset.lazy:
+            images = _decode(images, decode_size)
+        yield images, dataset.labels[chunk]
+
+
+def num_train_steps(n_examples: int, global_batch: int) -> int:
+    return n_examples // global_batch
+
+
+def prefetch(iterator, depth: int = 2):
+    """Run `iterator` in a background thread with a bounded queue —
+    double-buffered host -> device feed."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+    err: list[BaseException] = []
+
+    def worker():
+        try:
+            for item in iterator:
+                q.put(item)
+        except BaseException as e:  # propagate into the consumer
+            err.append(e)
+        finally:
+            q.put(_END)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+class BatchIterator:
+    """Convenience wrapper bundling a dataset + fold indices."""
+
+    def __init__(self, dataset: ArrayDataset, indices=None, decode_size=None):
+        self.dataset = dataset
+        self.indices = indices
+        self.decode_size = decode_size
+
+    def __len__(self):
+        return len(self.indices) if self.indices is not None else len(self.dataset)
+
+    def train_epoch(self, global_batch, epoch, **kw):
+        return train_batches(
+            self.dataset, self.indices, global_batch, epoch,
+            decode_size=self.decode_size, **kw,
+        )
+
+    def eval_epoch(self, batch):
+        return eval_batches(
+            self.dataset, self.indices, batch, decode_size=self.decode_size
+        )

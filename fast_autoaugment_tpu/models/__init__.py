@@ -1,0 +1,103 @@
+"""Model registry.
+
+Capability match for the reference ``networks/__init__.py:19-103``:
+string model types map to Flax modules.  Unlike the reference (which
+also wraps models in DDP/.cuda() here), device placement and sharding
+are the train step's concern — a module is pure structure.
+
+Supported types (reference parity): resnet50, resnet200, wresnet40_2,
+wresnet28_10, shakeshake26_2x32d / 2x64d / 2x96d / 2x112d,
+shakeshake26_2x96d_next, pyramid, efficientnet-b0..b7 (+condconv).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from flax import linen as nn
+
+from fast_autoaugment_tpu.models.pyramidnet import PyramidNet
+from fast_autoaugment_tpu.models.resnet import ResNet
+from fast_autoaugment_tpu.models.shake_resnet import ShakeResNet, ShakeResNeXt
+from fast_autoaugment_tpu.models.wideresnet import WideResNet
+
+__all__ = ["get_model", "num_class", "input_image_size"]
+
+
+def num_class(dataset: str) -> int:
+    """Class count per dataset (reference ``networks/__init__.py:93-103``)."""
+    if dataset.startswith("synthetic"):
+        return 100 if dataset.endswith("100") else 10
+    return {
+        "cifar10": 10,
+        "reduced_cifar10": 10,
+        "cifar10.1": 10,
+        "cifar100": 100,
+        "svhn": 10,
+        "reduced_svhn": 10,
+        "imagenet": 1000,
+        "reduced_imagenet": 120,
+    }[dataset]
+
+
+def input_image_size(dataset: str, model_type: str) -> int:
+    """Native input resolution for dataset/model."""
+    if dataset.endswith("imagenet"):
+        if model_type.startswith("efficientnet"):
+            from fast_autoaugment_tpu.models.efficientnet import efficientnet_params
+
+            return efficientnet_params(model_type.replace("-condconv", ""))[2]
+        return 224
+    return 32
+
+
+def get_model(conf: Any, num_classes: int) -> nn.Module:
+    """Build a Flax module from a model config mapping.
+
+    `conf` needs `.type` plus model-specific fields (reference conf
+    schema: `model{type, (depth, alpha, bottleneck) | (condconv_num_expert)}`).
+    """
+    name = conf["type"]
+    dataset = conf.get("dataset", "cifar")
+
+    if name == "resnet50":
+        return ResNet(dataset="imagenet", depth=50, num_classes=num_classes, bottleneck=True)
+    if name == "resnet200":
+        return ResNet(dataset="imagenet", depth=200, num_classes=num_classes, bottleneck=True)
+    if name.startswith("wresnet"):
+        # wresnet{depth}_{widen}
+        depth, widen = name[len("wresnet"):].split("_")
+        return WideResNet(
+            depth=int(depth),
+            widen_factor=int(widen),
+            num_classes=num_classes,
+            dropout_rate=0.0,
+        )
+    if name.startswith("shakeshake26_2x"):
+        rest = name[len("shakeshake26_2x"):]
+        if rest.endswith("d_next"):
+            return ShakeResNeXt(
+                depth=26, w_base=int(rest[:-len("d_next")]), cardinality=4,
+                num_classes=num_classes,
+            )
+        assert rest.endswith("d")
+        return ShakeResNet(depth=26, w_base=int(rest[:-1]), num_classes=num_classes)
+    if name == "pyramid":
+        return PyramidNet(
+            dataset=dataset if dataset.startswith("cifar") else "cifar10",
+            depth=int(conf["depth"]),
+            alpha=float(conf["alpha"]),
+            num_classes=num_classes,
+            bottleneck=bool(conf.get("bottleneck", True)),
+        )
+    if name.startswith("efficientnet"):
+        from fast_autoaugment_tpu.models.efficientnet import EfficientNet
+
+        condconv = "condconv" in name
+        base = name.replace("-condconv", "")
+        return EfficientNet.from_name(
+            base,
+            num_classes=num_classes,
+            condconv_num_expert=int(conf.get("condconv_num_expert", 0)) if condconv else 0,
+        )
+    raise ValueError(f"unknown model type {name!r}")

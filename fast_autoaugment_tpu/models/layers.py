@@ -1,0 +1,100 @@
+"""Shared layers and initializers for the model zoo.
+
+The reference models inherit PyTorch's *default* parameter init in most
+places (WideResNet's custom ``conv_init`` is commented out,
+``wideresnet.py:66``), while ImageNet ResNet uses He-normal fan-out
+(``resnet.py:126-132``).  Those distributions affect reproducibility,
+so both are provided here explicitly:
+
+- :data:`torch_default_kernel` / :func:`torch_default_bias` — PyTorch's
+  kaiming-uniform(a=sqrt 5) conv/linear default: U(+-1/sqrt(fan_in)).
+- :data:`he_normal_fanout` — N(0, sqrt(2 / (k*k*c_out))).
+
+All modules run NHWC, the TPU-native layout.  BatchNorm momentum
+conventions differ between frameworks: torch ``momentum`` is the weight
+of the NEW batch statistic, flax's is the weight of the OLD running
+average; the ``bn_momentum`` arguments here follow the torch convention
+used in the reference and are converted internally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+__all__ = [
+    "torch_default_kernel",
+    "torch_default_bias",
+    "he_normal_fanout",
+    "BatchNorm",
+    "global_avg_pool",
+]
+
+
+def torch_default_kernel(dtype=jnp.float32):
+    """PyTorch default conv/linear weight init: kaiming_uniform(a=sqrt(5)),
+    i.e. U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    return jax.nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform", dtype=dtype)
+
+
+def torch_default_bias(dtype=jnp.float32) -> Callable:
+    """PyTorch default bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
+
+    flax bias initializers don't see fan_in, so this returns a closure
+    factory: call with the matching kernel shape convention at module
+    build time via `bias_init=torch_default_bias_for(fan_in)`.
+    """
+    raise NotImplementedError("use torch_default_bias_for(fan_in)")
+
+
+def torch_default_bias_for(fan_in: int, dtype=jnp.float32) -> Callable:
+    bound = 1.0 / np.sqrt(fan_in) if fan_in > 0 else 0.0
+
+    def init(key, shape, dtype=dtype):
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init
+
+
+he_normal_fanout = jax.nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BatchNorm(nn.Module):
+    """BatchNorm with torch-convention momentum.
+
+    Under a single jitted train step over the global (mesh-sharded)
+    batch, XLA computes batch statistics over ALL replicas — this is
+    exactly the cross-replica BN the reference's ``TpuBatchNormalization``
+    (``tf_port/tpu_bn.py:8-58``) emulated with explicit allreduces, and
+    it is simply the default here.  When the train step instead runs
+    per-replica inside ``shard_map``, pass ``axis_name='data'`` to
+    reduce statistics with ``lax.pmean`` (the NCCL-allreduce analog).
+    """
+
+    momentum: float = 0.1  # torch convention: weight of the NEW stat
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=1.0 - self.momentum,
+            epsilon=self.epsilon,
+            use_scale=self.use_scale,
+            use_bias=self.use_bias,
+            axis_name=self.axis_name,
+            dtype=x.dtype,
+        )
+        return norm(x)
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    """NHWC global average pool -> [N, C]."""
+    return x.mean(axis=(1, 2))

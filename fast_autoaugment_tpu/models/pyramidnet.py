@@ -1,0 +1,165 @@
+"""PyramidNet with ShakeDrop in Flax, NHWC.
+
+Capability match for the reference ``networks/pyramidnet.py:15-248``:
+additive pyramidal channel growth (``addrate = alpha / (3n)``), zero-init
+BN-led bottleneck blocks, per-block linearly increasing ShakeDrop death
+rates up to 0.5 (``pyramidnet.py:135``), average-pool downsampling and
+zero-padded channel-mismatch shortcut adds.  The flagship config is
+pyramid272 (depth=272, alpha=200, bottleneck) used for the best CIFAR
+numbers (``confs/pyramid272_cifar.yaml``).
+
+Channel bookkeeping reproduces the reference exactly: widths accumulate
+as floats and round per block, with the block input tracked as
+``round(width) * expansion``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fast_autoaugment_tpu.models.layers import BatchNorm, global_avg_pool, he_normal_fanout
+from fast_autoaugment_tpu.ops.shake import (
+    sample_shake_drop_noise,
+    shake_drop,
+    shake_drop_eval,
+)
+
+__all__ = ["PyramidNet", "pyramidnet_plan"]
+
+
+def _conv(features, kernel, stride=1, name=None):
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(stride, stride),
+        padding=[(kernel // 2, kernel // 2)] * 2,
+        use_bias=False,
+        kernel_init=he_normal_fanout,
+        name=name,
+    )
+
+
+def pyramidnet_plan(depth: int, alpha: float, bottleneck: bool):
+    """Per-block (width, stride, p_shakedrop) plan, replicating the
+    reference's float accumulation (``pyramidnet.py:128-214``)."""
+    if bottleneck:
+        n = (depth - 2) // 9
+        expansion = 4
+    else:
+        n = (depth - 2) // 6
+        expansion = 1
+    total = 3 * n
+    addrate = alpha / (3.0 * n)
+    ps = [(0.5 / total) * (i + 1) for i in range(total)]
+    plan = []
+    featuremap_dim = 16.0
+    for stage in range(3):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            featuremap_dim += addrate
+            plan.append((int(round(featuremap_dim)), stride, ps.pop(0)))
+    assert not ps
+    return plan, expansion
+
+
+class _ShakeDropGate(nn.Module):
+    """Apply shake-drop noise from the 'shake' RNG stream."""
+
+    p_drop: float
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        if train:
+            gate, alpha, beta = sample_shake_drop_noise(
+                self.make_rng("shake"), x.shape[0], self.p_drop, x.dtype
+            )
+            return shake_drop(x, gate, alpha, beta)
+        return shake_drop_eval(x, self.p_drop)
+
+
+class PyramidBasicBlock(nn.Module):
+    """BN-conv3-BN-relu-conv3-BN (+ShakeDrop) (reference ``pyramidnet.py:15-60``)."""
+
+    features: int
+    stride: int
+    p_shakedrop: float
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        out = BatchNorm(name="bn1")(x, train)
+        out = _conv(self.features, 3, self.stride, name="conv1")(out)
+        out = BatchNorm(name="bn2")(out, train)
+        out = nn.relu(out)
+        out = _conv(self.features, 3, 1, name="conv2")(out)
+        out = BatchNorm(name="bn3")(out, train)
+        out = _ShakeDropGate(self.p_shakedrop, name="shake_drop")(out, train)
+        return _shortcut_add(x, out, self.stride)
+
+
+class PyramidBottleneck(nn.Module):
+    """BN-1x1-BN-relu-3x3-BN-relu-1x1-BN (+ShakeDrop)
+    (reference ``pyramidnet.py:63-118``)."""
+
+    features: int
+    stride: int
+    p_shakedrop: float
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        out = BatchNorm(name="bn1")(x, train)
+        out = _conv(self.features, 1, name="conv1")(out)
+        out = BatchNorm(name="bn2")(out, train)
+        out = nn.relu(out)
+        out = _conv(self.features, 3, self.stride, name="conv2")(out)
+        out = BatchNorm(name="bn3")(out, train)
+        out = nn.relu(out)
+        out = _conv(self.features * self.expansion, 1, name="conv3")(out)
+        out = BatchNorm(name="bn4")(out, train)
+        out = _ShakeDropGate(self.p_shakedrop, name="shake_drop")(out, train)
+        return _shortcut_add(x, out, self.stride)
+
+
+def _shortcut_add(x, out, stride):
+    """Average-pool downsample + zero-channel-pad shortcut
+    (reference ``pyramidnet.py:41-60,98-117,200-202``)."""
+    shortcut = x
+    if stride != 1:
+        # AvgPool2d((2,2), stride=2, ceil_mode=True)
+        h, w = x.shape[1], x.shape[2]
+        pad_h, pad_w = h % 2, w % 2
+        padded = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        counts = jnp.ones((1, h, w, 1), x.dtype)
+        counts = jnp.pad(counts, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        summed = nn.avg_pool(padded, (2, 2), strides=(2, 2)) * 4.0
+        denom = nn.avg_pool(counts, (2, 2), strides=(2, 2)) * 4.0
+        shortcut = summed / denom
+    pad_c = out.shape[-1] - shortcut.shape[-1]
+    if pad_c > 0:
+        shortcut = jnp.pad(shortcut, ((0, 0), (0, 0), (0, 0), (0, pad_c)))
+    return out + shortcut
+
+
+class PyramidNet(nn.Module):
+    """dataset in {'cifar10', 'cifar100', 'svhn'}; ImageNet variant uses the
+    4-stage stem (reference ``pyramidnet.py:157-190``)."""
+
+    dataset: str
+    depth: int
+    alpha: float
+    num_classes: int
+    bottleneck: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        plan, expansion = pyramidnet_plan(self.depth, self.alpha, self.bottleneck)
+        block = PyramidBottleneck if self.bottleneck else PyramidBasicBlock
+        out = _conv(16, 3, 1, name="conv1")(x)
+        out = BatchNorm(name="bn1")(out, train)
+        for idx, (width, stride, p_sd) in enumerate(plan):
+            out = block(width, stride, p_sd, name=f"block{idx}")(out, train)
+        out = BatchNorm(name="bn_final")(out, train)
+        out = nn.relu(out)
+        out = global_avg_pool(out)
+        return nn.Dense(self.num_classes, name="fc")(out)

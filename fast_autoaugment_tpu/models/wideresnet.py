@@ -1,0 +1,98 @@
+"""WideResNet (WRN-d-k) in Flax, NHWC.
+
+Capability match for the reference ``networks/wideresnet.py:21-85``:
+pre-activation wide basic blocks with conv bias=True, dropout between
+the two convs, BN with torch-momentum 0.9 (i.e. running stats track the
+latest batch heavily), 1x1-conv shortcut on shape change, global
+average pool head.  Parameter init follows PyTorch defaults (the
+reference's custom init is commented out, ``wideresnet.py:66``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fast_autoaugment_tpu.models.layers import (
+    BatchNorm,
+    global_avg_pool,
+    torch_default_bias_for,
+    torch_default_kernel,
+)
+
+__all__ = ["WideResNet"]
+
+_BN_MOMENTUM = 0.9  # torch convention, reference wideresnet.py:24
+
+
+def _conv(features: int, kernel: int, stride: int, in_features: int, name: str | None = None):
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(stride, stride),
+        padding=[(kernel // 2, kernel // 2)] * 2,
+        use_bias=True,
+        kernel_init=torch_default_kernel(),
+        bias_init=torch_default_bias_for(in_features * kernel * kernel),
+        name=name,
+    )
+
+
+class WideBasic(nn.Module):
+    """Pre-activation wide basic block (reference ``wideresnet.py:21-41``)."""
+
+    features: int
+    stride: int
+    dropout_rate: float
+
+    @nn.compact
+    def __call__(self, x, train: bool, dropout_rng=None):
+        in_features = x.shape[-1]
+        out = nn.relu(BatchNorm(momentum=_BN_MOMENTUM, name="bn1")(x, train))
+        out = _conv(self.features, 3, 1, in_features, name="conv1")(out)
+        if self.dropout_rate > 0.0:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        out = nn.relu(BatchNorm(momentum=_BN_MOMENTUM, name="bn2")(out, train))
+        out = _conv(self.features, 3, self.stride, self.features, name="conv2")(out)
+        if self.stride != 1 or in_features != self.features:
+            shortcut = _conv(self.features, 1, self.stride, in_features, name="shortcut")(x)
+        else:
+            shortcut = x
+        return out + shortcut
+
+
+class WideResNet(nn.Module):
+    """WRN-depth-widen_factor; depth = 6n + 4 (reference ``wideresnet.py:44-85``)."""
+
+    depth: int
+    widen_factor: int
+    num_classes: int
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        assert (self.depth - 4) % 6 == 0, "WideResNet depth must be 6n+4"
+        n = (self.depth - 4) // 6
+        k = self.widen_factor
+        stages = (16, 16 * k, 32 * k, 64 * k)
+
+        out = _conv(stages[0], 3, 1, x.shape[-1], name="conv1")(x)
+        for stage, (features, stride) in enumerate(
+            zip(stages[1:], (1, 2, 2)), start=1
+        ):
+            for i in range(n):
+                out = WideBasic(
+                    features,
+                    stride if i == 0 else 1,
+                    self.dropout_rate,
+                    name=f"layer{stage}_{i}",
+                )(out, train)
+        out = nn.relu(BatchNorm(momentum=_BN_MOMENTUM, name="bn1")(out, train))
+        out = global_avg_pool(out)
+        out = nn.Dense(
+            self.num_classes,
+            kernel_init=torch_default_kernel(),
+            bias_init=torch_default_bias_for(stages[3]),
+            name="linear",
+        )(out)
+        return out

@@ -1,0 +1,422 @@
+"""On-device batched augmentation kernels.
+
+The reference applies its 19 registered augmentation ops per-image with
+PIL on CPU DataLoader workers (reference ``augmentations.py:13-194``,
+``data.py:253-264``).  Here every op is a pure ``jnp`` function on a
+``[H, W, C]`` float32 image holding integral uint8 values in [0, 255],
+with explicit PRNG keys, vmapped over the batch and jit-compiled — the
+augmentation runs on the TPU, fused into the input side of the train
+step, and the *policy is a tensor input* rather than Python structure.
+That last property is what makes TTA policy search fast: one compiled
+evaluation step serves every candidate policy (SURVEY.md section 7).
+
+Semantics were pinned against PIL empirically and are exact (see
+``tests/test_augment_golden.py``):
+
+- affine/rotate: nearest-neighbor, ``src = floor(A @ (x, y) + t + 0.5)``,
+  fill 0, rotate about ``((W-1)/2, (H-1)/2)``  (PIL ``Image.transform``
+  with ``AFFINE`` / ``Image.rotate``, reference ``augmentations.py:17-62``)
+- L (grayscale): ``(r*19595 + g*38470 + b*7471 + 0x8000) >> 16``
+- enhance ops: ``clip(trunc(deg + (img - deg) * factor), 0, 255)`` in
+  float32 (PIL ``ImageEnhance`` via ``Image.blend``)
+- equalize / autocontrast: PIL's exact integer LUT constructions
+- SMOOTH filter (sharpness degenerate): 3x3 kernel [[1,1,1],[1,5,1],
+  [1,1,1]]/13, ``trunc(acc + 0.5)``, 1-pixel border copied unfiltered
+
+Op registry (19 ops) mirrors the reference's ``augment_list(True)``
+(``augmentations.py:156-182``): indices 0-14 are the searchable ops
+(``augment_list(False)``), 15-18 the AutoAugment-compat extras.  ``Flip``
+exists in the reference source but is never registered (SURVEY.md
+errata 1) — provided here as a standalone function only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "OP_NAMES",
+    "SEARCH_OP_NAMES",
+    "op_index",
+    "augment_list",
+    "apply_augment",
+    "apply_op",
+    "apply_subpolicy",
+    "apply_policy",
+    "apply_policy_batch",
+    "CUTOUT_COLOR",
+]
+
+# (name, low, high, mirrored): value = level * (high - low) + low, then the
+# sign is flipped with prob 0.5 when `mirrored` (reference `random_mirror`,
+# augmentations.py:10-16; TranslateX/YAbs always mirror, :44-56).
+_OP_TABLE = (
+    ("ShearX", -0.3, 0.3, True),
+    ("ShearY", -0.3, 0.3, True),
+    ("TranslateX", -0.45, 0.45, True),
+    ("TranslateY", -0.45, 0.45, True),
+    ("Rotate", -30.0, 30.0, True),
+    ("AutoContrast", 0.0, 1.0, False),
+    ("Invert", 0.0, 1.0, False),
+    ("Equalize", 0.0, 1.0, False),
+    ("Solarize", 0.0, 256.0, False),
+    ("Posterize", 4.0, 8.0, False),
+    ("Contrast", 0.1, 1.9, False),
+    ("Color", 0.1, 1.9, False),
+    ("Brightness", 0.1, 1.9, False),
+    ("Sharpness", 0.1, 1.9, False),
+    ("Cutout", 0.0, 0.2, False),
+    ("CutoutAbs", 0.0, 20.0, False),  # no sign flip (augmentations.py:127-131)
+    ("Posterize2", 0.0, 4.0, False),
+    ("TranslateXAbs", 0.0, 10.0, True),
+    ("TranslateYAbs", 0.0, 10.0, True),
+)
+
+OP_NAMES: tuple[str, ...] = tuple(t[0] for t in _OP_TABLE)
+NUM_OPS = len(OP_NAMES)
+SEARCH_OP_NAMES: tuple[str, ...] = OP_NAMES[:15]  # augment_list(False)
+_OP_LOW = np.array([t[1] for t in _OP_TABLE], np.float32)
+_OP_HIGH = np.array([t[2] for t in _OP_TABLE], np.float32)
+_OP_MIRROR = np.array([t[3] for t in _OP_TABLE], np.bool_)
+
+CUTOUT_COLOR = (125.0, 123.0, 114.0)  # reference augmentations.py:140
+
+
+def op_index(name: str) -> int:
+    return OP_NAMES.index(name)
+
+
+def augment_list(for_autoaug: bool = True) -> list[tuple[str, float, float]]:
+    """Name/range table, same contract as reference ``augment_list``."""
+    rows = _OP_TABLE if for_autoaug else _OP_TABLE[:15]
+    return [(name, low, high) for name, low, high, _ in rows]
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def _to_int(img: jax.Array) -> jax.Array:
+    return jnp.clip(img, 0.0, 255.0).astype(jnp.int32)
+
+
+def _grayscale_u8(img: jax.Array) -> jax.Array:
+    """PIL 'L' conversion on integral-valued float input -> int32 [H, W]."""
+    ii = _to_int(img)
+    r, g, b = ii[..., 0], ii[..., 1], ii[..., 2]
+    return (r * 19595 + g * 38470 + b * 7471 + 0x8000) >> 16
+
+
+def _blend(degenerate: jax.Array, img: jax.Array, factor: jax.Array) -> jax.Array:
+    """PIL Image.blend + uint8 store: float32 lerp, trunc, clip."""
+    out = degenerate + (img - degenerate) * factor
+    return jnp.clip(jnp.trunc(out), 0.0, 255.0)
+
+
+def _apply_lut(img: jax.Array, lut: jax.Array) -> jax.Array:
+    """Per-channel 256-entry LUT gather; lut [C, 256] or [256]."""
+    ii = _to_int(img)
+    if lut.ndim == 1:
+        return lut[ii].astype(jnp.float32)
+    out = jnp.stack([lut[c][ii[..., c]] for c in range(img.shape[-1])], axis=-1)
+    return out.astype(jnp.float32)
+
+
+def _warp_affine_nearest(img: jax.Array, mat: jax.Array) -> jax.Array:
+    """PIL-exact nearest affine warp with zero fill.
+
+    `mat` is the 2x3 PIL-convention inverse map [[a, b, c], [d, e, f]]
+    from output to source coords.  PIL samples at pixel centers with a
+    plain floor: ``src = floor(A @ (x+0.5, y+0.5) + t)`` (pinned
+    empirically; the center offset matters for tie-breaking at .5).
+    """
+    h, w = img.shape[0], img.shape[1]
+    ys, xs = jnp.mgrid[0:h, 0:w]
+    xsf, ysf = xs.astype(jnp.float32) + 0.5, ys.astype(jnp.float32) + 0.5
+    sx = jnp.floor(mat[0, 0] * xsf + mat[0, 1] * ysf + mat[0, 2]).astype(jnp.int32)
+    sy = jnp.floor(mat[1, 0] * xsf + mat[1, 1] * ysf + mat[1, 2]).astype(jnp.int32)
+    valid = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
+    gathered = img[jnp.clip(sy, 0, h - 1), jnp.clip(sx, 0, w - 1)]
+    return jnp.where(valid[..., None], gathered, 0.0)
+
+
+def _histogram256(channel_int: jax.Array) -> jax.Array:
+    return jnp.zeros((256,), jnp.int32).at[channel_int.reshape(-1)].add(1)
+
+
+# ---------------------------------------------------------------------------
+# the 19 ops — each is (img [H,W,C] f32 integral, value f32 scalar, key) -> img
+# ---------------------------------------------------------------------------
+
+
+def shear_x(img, v, key):
+    return _warp_affine_nearest(img, jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]).at[0, 1].set(v))
+
+
+def shear_y(img, v, key):
+    return _warp_affine_nearest(img, jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]).at[1, 0].set(v))
+
+
+def translate_x(img, v, key):
+    # fractional of width (reference augmentations.py:28-33)
+    shift = v * img.shape[1]
+    return _warp_affine_nearest(img, jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]).at[0, 2].set(shift))
+
+
+def translate_y(img, v, key):
+    shift = v * img.shape[0]
+    return _warp_affine_nearest(img, jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]).at[1, 2].set(shift))
+
+
+def translate_x_abs(img, v, key):
+    return _warp_affine_nearest(img, jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]).at[0, 2].set(v))
+
+
+def translate_y_abs(img, v, key):
+    return _warp_affine_nearest(img, jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]).at[1, 2].set(v))
+
+
+def rotate(img, v, key):
+    """PIL Image.rotate(v): CCW degrees about (W/2, H/2), nearest."""
+    h, w = img.shape[0], img.shape[1]
+    cx, cy = w / 2.0, h / 2.0
+    rad = v * (np.pi / 180.0)
+    ca, sa = jnp.cos(rad), jnp.sin(rad)
+    mat = jnp.array(
+        [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]
+    )
+    mat = mat.at[0, 0].set(ca).at[0, 1].set(-sa).at[0, 2].set(cx - ca * cx + sa * cy)
+    mat = mat.at[1, 0].set(sa).at[1, 1].set(ca).at[1, 2].set(cy - sa * cx - ca * cy)
+    return _warp_affine_nearest(img, mat)
+
+
+def auto_contrast(img, v, key):
+    """PIL ImageOps.autocontrast(cutoff=0): per-channel min/max stretch LUT.
+
+    Computed as the exact rational ``(i - lo) * 255 // (hi - lo)``.  PIL
+    evaluates the same map in double precision with truncation, which
+    lands 1 below the exact value on ~20% of images — so outputs may
+    differ from PIL by at most 1 (deliberate deviation; the exact form
+    is stable in float-free integer math on device).
+    """
+    ii = _to_int(img)
+    lo = ii.min(axis=(0, 1))  # [C]
+    hi = ii.max(axis=(0, 1))
+    ix = jnp.arange(256, dtype=jnp.int32)
+    span = jnp.maximum(hi - lo, 1)
+    lut = jnp.clip((ix[None, :] - lo[:, None]) * 255 // span[:, None], 0, 255)
+    identity = hi <= lo
+    lut = jnp.where(identity[:, None], ix[None, :], lut)
+    return _apply_lut(img, lut)
+
+
+def invert(img, v, key):
+    return 255.0 - jnp.clip(img, 0.0, 255.0)
+
+
+def equalize(img, v, key):
+    """PIL ImageOps.equalize: per-channel integer histogram remap."""
+    ii = _to_int(img)
+
+    def one_channel(ch):
+        h = _histogram256(ch)
+        total = jnp.sum(h)
+        nonzero = h > 0
+        num_nonzero = jnp.sum(nonzero)
+        # value of the last nonzero bin
+        last_idx = 255 - jnp.argmax(nonzero[::-1])
+        h_last = h[last_idx]
+        step = (total - h_last) // 255
+        csum = jnp.cumsum(h) - h  # exclusive cumsum
+        n = step // 2 + csum
+        lut = jnp.clip(n // jnp.maximum(step, 1), 0, 255)
+        ix = jnp.arange(256, dtype=jnp.int32)
+        use_identity = (num_nonzero <= 1) | (step == 0)
+        return jnp.where(use_identity, ix, lut)
+
+    lut = jnp.stack([one_channel(ii[..., c]) for c in range(img.shape[-1])])
+    return _apply_lut(img, lut)
+
+
+def solarize(img, v, key):
+    ii = jnp.clip(img, 0.0, 255.0)
+    return jnp.where(ii < v, ii, 255.0 - ii)
+
+
+def _posterize_bits(img, bits):
+    mask = jnp.left_shift(jnp.int32(0xFF), 8 - bits) & 0xFF
+    return (_to_int(img) & mask).astype(jnp.float32)
+
+
+def posterize(img, v, key):
+    # int(v), v in [4, 8] (reference augmentations.py:85-88)
+    return _posterize_bits(img, jnp.trunc(v).astype(jnp.int32))
+
+
+def posterize2(img, v, key):
+    # v in [0, 4] (reference augmentations.py:91-94)
+    return _posterize_bits(img, jnp.trunc(v).astype(jnp.int32))
+
+
+def contrast(img, v, key):
+    gray = _grayscale_u8(img)
+    mean = jnp.trunc(gray.astype(jnp.float32).mean() + 0.5)
+    return _blend(jnp.full_like(img, mean), jnp.clip(img, 0.0, 255.0), v)
+
+
+def color(img, v, key):
+    deg = jnp.repeat(_grayscale_u8(img)[..., None].astype(jnp.float32), img.shape[-1], axis=-1)
+    return _blend(deg, jnp.clip(img, 0.0, 255.0), v)
+
+
+def brightness(img, v, key):
+    return _blend(jnp.zeros_like(img), jnp.clip(img, 0.0, 255.0), v)
+
+
+def _smooth_degenerate(img: jax.Array) -> jax.Array:
+    """PIL ImageFilter.SMOOTH: 3x3 [[1,1,1],[1,5,1],[1,1,1]]/13, border copied."""
+    h, w = img.shape[0], img.shape[1]
+    kernel = np.array([[1, 1, 1], [1, 5, 1], [1, 1, 1]], np.float32) / 13.0
+    padded = jnp.pad(img, ((1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros_like(img)
+    for dy in range(3):
+        for dx in range(3):
+            acc = acc + kernel[dy, dx] * jax.lax.dynamic_slice(
+                padded, (dy, dx, 0), (h, w, img.shape[2])
+            )
+    sm = jnp.clip(jnp.trunc(acc + 0.5), 0.0, 255.0)
+    border = jnp.zeros((h, w, 1), bool).at[0, :].set(True).at[-1, :].set(True).at[:, 0].set(True).at[:, -1].set(True)
+    return jnp.where(border, jnp.clip(img, 0.0, 255.0), sm)
+
+
+def sharpness(img, v, key):
+    return _blend(_smooth_degenerate(img), jnp.clip(img, 0.0, 255.0), v)
+
+
+def _cutout_abs(img, v, key):
+    """Gray rectangle at uniform center (reference CutoutAbs, augmentations.py:127-146).
+
+    PIL's ImageDraw.rectangle fills the box *inclusive* of (x1, y1).
+    """
+    h, w = img.shape[0], img.shape[1]
+    kx, ky = jax.random.split(key)
+    x0f = jax.random.uniform(kx, (), minval=0.0, maxval=float(w))
+    y0f = jax.random.uniform(ky, (), minval=0.0, maxval=float(h))
+    x0 = jnp.trunc(jnp.maximum(0.0, x0f - v / 2.0))
+    y0 = jnp.trunc(jnp.maximum(0.0, y0f - v / 2.0))
+    x1 = jnp.minimum(float(w), x0 + v)
+    y1 = jnp.minimum(float(h), y0 + v)
+    ys, xs = jnp.mgrid[0:h, 0:w]
+    inside = (
+        (xs.astype(jnp.float32) >= x0)
+        & (xs.astype(jnp.float32) <= x1)
+        & (ys.astype(jnp.float32) >= y0)
+        & (ys.astype(jnp.float32) <= y1)
+    )
+    fill = jnp.asarray(CUTOUT_COLOR, img.dtype)
+    out = jnp.where(inside[..., None], fill, img)
+    return jnp.where(v < 0.0, img, out)
+
+
+def cutout(img, v, key):
+    # fractional of width; <= 0 is identity (reference augmentations.py:118-124)
+    out = _cutout_abs(img, v * img.shape[1], key)
+    return jnp.where(v <= 0.0, img, out)
+
+
+def cutout_abs(img, v, key):
+    return _cutout_abs(img, v, key)
+
+
+def flip(img, v, key):
+    """PIL ImageOps.mirror — defined in the reference but never registered."""
+    return img[:, ::-1]
+
+
+_OP_FNS = (
+    shear_x, shear_y, translate_x, translate_y, rotate,
+    auto_contrast, invert, equalize, solarize, posterize,
+    contrast, color, brightness, sharpness, cutout,
+    cutout_abs, posterize2, translate_x_abs, translate_y_abs,
+)
+assert len(_OP_FNS) == NUM_OPS
+
+
+# ---------------------------------------------------------------------------
+# dispatch + policy application
+# ---------------------------------------------------------------------------
+
+
+def apply_augment(img: jax.Array, name: str, level, key: jax.Array) -> jax.Array:
+    """Single named op at `level` in [0, 1] (reference ``apply_augment``,
+    ``augmentations.py:192-194``) — includes the random mirror."""
+    return apply_op(img, jnp.int32(op_index(name)), jnp.float32(level), key)
+
+
+def apply_op(img: jax.Array, op_idx: jax.Array, level: jax.Array, key: jax.Array) -> jax.Array:
+    """Apply op `op_idx` (traced scalar) at `level` in [0, 1].
+
+    Maps level -> value = level*(high-low)+low and flips the sign with
+    prob 0.5 for mirrored (geometric) ops, then dispatches via
+    ``lax.switch`` so the op id can be a runtime tensor (policy-as-data).
+    """
+    key_mirror, key_op = jax.random.split(key)
+    low = jnp.asarray(_OP_LOW)[op_idx]
+    high = jnp.asarray(_OP_HIGH)[op_idx]
+    value = level * (high - low) + low
+    mirrored = jnp.asarray(_OP_MIRROR)[op_idx]
+    sign = jnp.where(
+        mirrored & (jax.random.uniform(key_mirror) > 0.5), -1.0, 1.0
+    )
+    value = value * sign
+    branches = [functools.partial(_call_op, fn) for fn in _OP_FNS]
+    return jax.lax.switch(op_idx, branches, img, value, key_op)
+
+
+def _call_op(fn, img, value, key):
+    return fn(img, value, key)
+
+
+def apply_subpolicy(img: jax.Array, subpolicy: jax.Array, key: jax.Array) -> jax.Array:
+    """Apply one sub-policy: rows of (op_idx, prob, level).
+
+    Each op fires independently with its probability (reference
+    ``Augmentation.__call__``, ``data.py:257-263``).
+    """
+    num_op = subpolicy.shape[0]
+
+    def body(i, carry):
+        img, key = carry
+        key, key_gate, key_op = jax.random.split(key, 3)
+        op_idx = subpolicy[i, 0].astype(jnp.int32)
+        prob = subpolicy[i, 1]
+        level = subpolicy[i, 2]
+        out = apply_op(img, op_idx, level, key_op)
+        img = jnp.where(jax.random.uniform(key_gate) < prob, out, img)
+        return img, key
+
+    # num_op is tiny (2); unrolled python loop keeps XLA free to fuse
+    carry = (img, key)
+    for i in range(num_op):
+        carry = body(i, carry)
+    return carry[0]
+
+
+def apply_policy(img: jax.Array, policy: jax.Array, key: jax.Array) -> jax.Array:
+    """Pick one random sub-policy from `policy` [num_sub, num_op, 3] and
+    apply it (reference ``Augmentation``, ``data.py:253-264``)."""
+    key_choice, key_sub = jax.random.split(key)
+    idx = jax.random.randint(key_choice, (), 0, policy.shape[0])
+    return apply_subpolicy(img, policy[idx], key_sub)
+
+
+def apply_policy_batch(images: jax.Array, policy: jax.Array, key: jax.Array) -> jax.Array:
+    """vmapped :func:`apply_policy` over a [B, H, W, C] batch."""
+    keys = jax.random.split(key, images.shape[0])
+    return jax.vmap(apply_policy, in_axes=(0, None, 0))(images, policy, keys)

@@ -1,0 +1,123 @@
+"""On-device train/eval preprocessing stacks.
+
+The reference runs its baseline transforms (RandomCrop+pad, HFlip,
+Normalize, Cutout) per-image on CPU DataLoader workers
+(``data.py:38-47,111-112``).  Here the full train-time stack — baseline
+transforms, the augmentation *policy*, normalization and post-normalize
+cutout — is one jit-compiled batched function executed on device, fused
+with the train step.  The host only supplies raw uint8 batches.
+
+Order reproduces the reference exactly (``data.py:88-112``): the policy
+is applied FIRST (inserted at transforms[0], on raw pixels), then random
+crop + flip, then normalize, then CutoutDefault (which zeroes a box on
+the *normalized* tensor — so the fill is the per-channel mean, unlike
+the policy's gray Cutout op on raw pixels).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_autoaugment_tpu.ops.augment import apply_policy
+
+__all__ = [
+    "CIFAR_MEAN",
+    "CIFAR_STD",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "normalize",
+    "random_crop_with_pad",
+    "random_hflip",
+    "cutout_default",
+    "cifar_train_batch",
+    "cifar_eval_batch",
+]
+
+CIFAR_MEAN = (0.4914, 0.4822, 0.4465)  # reference data.py:34
+CIFAR_STD = (0.2023, 0.1994, 0.2010)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)  # reference data.py:71
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def normalize(img: jax.Array, mean: Sequence[float], std: Sequence[float]) -> jax.Array:
+    """uint8-valued [0..255] float -> normalized float (ToTensor + Normalize)."""
+    mean = jnp.asarray(mean, img.dtype)
+    std = jnp.asarray(std, img.dtype)
+    return (img / 255.0 - mean) / std
+
+
+def random_crop_with_pad(img: jax.Array, key: jax.Array, pad: int = 4) -> jax.Array:
+    """torchvision RandomCrop(size, padding=pad) with zero fill: pad all
+    sides then take a random crop at the original size."""
+    h, w, c = img.shape
+    padded = jnp.pad(img, ((pad, pad), (pad, pad), (0, 0)))
+    ky, kx = jax.random.split(key)
+    oy = jax.random.randint(ky, (), 0, 2 * pad + 1)
+    ox = jax.random.randint(kx, (), 0, 2 * pad + 1)
+    return jax.lax.dynamic_slice(padded, (oy, ox, 0), (h, w, c))
+
+
+def random_hflip(img: jax.Array, key: jax.Array) -> jax.Array:
+    return jnp.where(jax.random.uniform(key) < 0.5, img[:, ::-1], img)
+
+
+def cutout_default(img: jax.Array, key: jax.Array, length: int) -> jax.Array:
+    """DARTS-style cutout on the normalized tensor (reference
+    ``CutoutDefault``, ``data.py:228-250``): zero a length x length box
+    centered at a uniform integer pixel, clipped at the borders."""
+    h, w = img.shape[0], img.shape[1]
+    ky, kx = jax.random.split(key)
+    y = jax.random.randint(ky, (), 0, h)
+    x = jax.random.randint(kx, (), 0, w)
+    ys, xs = jnp.mgrid[0:h, 0:w]
+    inside = (
+        (ys >= y - length // 2)
+        & (ys < y + length // 2)
+        & (xs >= x - length // 2)
+        & (xs < x + length // 2)
+    )
+    return jnp.where(inside[..., None], 0.0, img)
+
+
+def _cifar_train_one(img, policy, key, cutout_length, mean, std):
+    k_policy, k_crop, k_flip, k_cutout = jax.random.split(key, 4)
+    if policy is not None:
+        img = apply_policy(img, policy, k_policy)
+    img = random_crop_with_pad(img, k_crop, 4)
+    img = random_hflip(img, k_flip)
+    img = normalize(img, mean, std)
+    if cutout_length > 0:
+        img = cutout_default(img, k_cutout, cutout_length)
+    return img
+
+
+def cifar_train_batch(
+    images: jax.Array,
+    key: jax.Array,
+    policy: jax.Array | None = None,
+    cutout_length: int = 16,
+    mean: Sequence[float] = CIFAR_MEAN,
+    std: Sequence[float] = CIFAR_STD,
+) -> jax.Array:
+    """Full CIFAR/SVHN train-time stack on a [B, H, W, C] uint8-valued batch.
+
+    `policy` is a [num_sub, num_op, 3] tensor (or None for 'default' aug).
+    """
+    images = images.astype(jnp.float32)
+    keys = jax.random.split(key, images.shape[0])
+    return jax.vmap(
+        lambda im, k: _cifar_train_one(im, policy, k, cutout_length, mean, std)
+    )(images, keys)
+
+
+def cifar_eval_batch(
+    images: jax.Array,
+    mean: Sequence[float] = CIFAR_MEAN,
+    std: Sequence[float] = CIFAR_STD,
+) -> jax.Array:
+    """Eval stack: normalize only (reference ``data.py:45-47``)."""
+    return normalize(images.astype(jnp.float32), mean, std)

@@ -1,0 +1,115 @@
+"""Learning-rate schedules as pure functions of the global step.
+
+The reference steps its torch schedulers once per BATCH with a
+fractional epoch ``epoch - 1 + steps/total`` (``train.py:90-91``), so
+every schedule here is a pure function of fractional epoch
+``t = step / steps_per_epoch``, trivially usable inside a jitted train
+step.  Implemented schedules (reference ``train.py:158-174``,
+``lr_scheduler.py:6-27``):
+
+- cosine: ``base * (1 + cos(pi t / T)) / 2`` (CosineAnnealingLR, eta_min 0)
+- resnet step: x0.1 at {30, 60, 80} for 90 epochs / {90, 180, 240} for 270
+- efficientnet: ``0.97 ** int(t / 2.4)``
+- gradual warmup wrapper (the external ``warmup_scheduler`` package the
+  reference depends on): linear base -> base*multiplier over
+  ``warmup_epoch``, after which the inner schedule runs with its epoch
+  shifted by -warmup_epoch and its base lr scaled by the multiplier.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["cosine", "multistep", "exponential_efficientnet", "warmup_wrap", "build_schedule"]
+
+Schedule = Callable  # t (fractional epoch, jnp scalar) -> lr
+
+
+def cosine(base_lr: float, total_epochs: float) -> Schedule:
+    def fn(t):
+        return base_lr * (1.0 + jnp.cos(jnp.pi * t / total_epochs)) / 2.0
+
+    return fn
+
+
+def multistep(base_lr: float, milestones: Sequence[float], gamma: float = 0.1) -> Schedule:
+    ms = np.asarray(milestones, np.float32)
+
+    def fn(t):
+        count = jnp.sum(jnp.asarray(t, jnp.float32) >= ms)
+        return base_lr * gamma ** count.astype(jnp.float32)
+
+    return fn
+
+
+def exponential_efficientnet(base_lr: float, warmup_epoch: float) -> Schedule:
+    """LambdaLR ``0.97 ** int((x + warmup_epoch) / 2.4)`` (``train.py:163-164``)
+    where x is the post-warmup shifted epoch."""
+
+    def fn(t_shifted):
+        k = jnp.floor((t_shifted + warmup_epoch) / 2.4)
+        return base_lr * 0.97**k
+
+    return fn
+
+
+def warmup_wrap(inner: Schedule, base_lr: float, multiplier: float, warmup_epoch: float,
+                inner_base_scale: bool = True) -> Schedule:
+    """GradualWarmupScheduler semantics.
+
+    For t <= warmup_epoch: ``base * ((multiplier - 1) * t / warmup + 1)``.
+    After: ``multiplier * inner(t - warmup_epoch)`` (the package rescales
+    the inner scheduler's base lrs and shifts its epoch).
+    """
+
+    def fn(t):
+        t = jnp.asarray(t, jnp.float32)
+        warm = base_lr * ((multiplier - 1.0) * t / warmup_epoch + 1.0)
+        after = multiplier * inner(t - warmup_epoch) if inner_base_scale else inner(t - warmup_epoch)
+        return jnp.where(t <= warmup_epoch, warm, after)
+
+    return fn
+
+
+def build_schedule(conf: Any, steps_per_epoch: int, world_lr_scale: float = 1.0) -> Callable:
+    """Build lr(step) from the conf schema
+    ``{lr, epoch, lr_schedule{type, warmup{multiplier, epoch}}}``.
+
+    `world_lr_scale` reproduces the linear LR scaling by data-parallel
+    world size (``train.py:117``).  Returns a function of the global
+    (0-based) optimizer step.
+    """
+    base_lr = float(conf["lr"]) * world_lr_scale
+    total_epochs = float(conf["epoch"])
+    sched_conf = conf.get("lr_schedule", {}) or {}
+    kind = sched_conf.get("type", "cosine") if hasattr(sched_conf, "get") else "cosine"
+    warmup = sched_conf.get("warmup", None) if hasattr(sched_conf, "get") else None
+    warmup_epoch = float(warmup["epoch"]) if warmup else 0.0
+
+    if kind == "cosine":
+        inner = cosine(base_lr, total_epochs)
+    elif kind == "resnet":
+        if int(total_epochs) == 90:
+            inner = multistep(base_lr, (30, 60, 80))
+        elif int(total_epochs) == 270:
+            inner = multistep(base_lr, (90, 180, 240))
+        else:
+            raise ValueError(f"invalid epoch={total_epochs} for resnet schedule")
+    elif kind == "efficientnet":
+        inner = exponential_efficientnet(base_lr, warmup_epoch)
+    else:
+        raise ValueError(f"invalid lr_schedule {kind!r}")
+
+    if warmup and warmup_epoch > 0:
+        epoch_fn = warmup_wrap(inner, base_lr, float(warmup["multiplier"]), warmup_epoch)
+    else:
+        epoch_fn = inner
+
+    def lr_at_step(step):
+        t = jnp.asarray(step, jnp.float32) / float(steps_per_epoch)
+        return epoch_fn(t)
+
+    return lr_at_step

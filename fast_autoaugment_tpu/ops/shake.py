@@ -1,0 +1,110 @@
+"""Stochastic shake regularizers with independent forward/backward noise.
+
+ShakeShake (reference ``networks/shakeshake/shakeshake.py:9-26``) mixes
+two branches with per-sample alpha ~ U(0,1) in the forward pass but
+back-propagates through a FRESH per-sample beta ~ U(0,1) — the backward
+randomness is intentionally different from the forward.  ShakeDrop
+(reference ``networks/shakedrop.py:9-45``) gates a residual branch with
+a per-call Bernoulli; on "drop" it scales forward by per-sample
+alpha ~ U(-1,1) and backward by fresh beta ~ U(0,1).
+
+Autodiff can't express "different randomness on the way back", so these
+are ``jax.custom_vjp`` primitives taking BOTH noises as explicit array
+arguments (sampled by the caller from split PRNG keys).  This keeps
+them pure, jit/vmap/pjit-compatible, and trivially testable — the VJP
+tests verify the backward really uses beta, not alpha.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["shake_shake", "shake_shake_eval", "shake_drop", "shake_drop_eval",
+           "sample_shake_shake_noise", "sample_shake_drop_noise"]
+
+
+# ---------------------------------------------------------------------------
+# ShakeShake
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def shake_shake(x1: jax.Array, x2: jax.Array, alpha: jax.Array, beta: jax.Array) -> jax.Array:
+    """Forward: alpha * x1 + (1 - alpha) * x2; backward mixes grads by beta.
+
+    alpha/beta broadcast against x (shape [B, 1, 1, 1] for per-sample).
+    """
+    return alpha * x1 + (1.0 - alpha) * x2
+
+
+def _shake_shake_fwd(x1, x2, alpha, beta):
+    return shake_shake(x1, x2, alpha, beta), beta
+
+
+def _shake_shake_bwd(beta, g):
+    return (beta * g, (1.0 - beta) * g, jnp.zeros_like(beta), jnp.zeros_like(beta))
+
+
+shake_shake.defvjp(_shake_shake_fwd, _shake_shake_bwd)
+
+
+def shake_shake_eval(x1: jax.Array, x2: jax.Array) -> jax.Array:
+    """Eval path: deterministic 0.5 mix (reference ``shakeshake.py:17``)."""
+    return 0.5 * (x1 + x2)
+
+
+def sample_shake_shake_noise(key: jax.Array, batch: int, dtype=jnp.float32):
+    """Per-sample (alpha, beta) ~ U(0,1), shaped [B, 1, 1, 1]."""
+    ka, kb = jax.random.split(key)
+    shape = (batch, 1, 1, 1)
+    return (jax.random.uniform(ka, shape, dtype),
+            jax.random.uniform(kb, shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# ShakeDrop
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def shake_drop(x: jax.Array, gate: jax.Array, alpha: jax.Array, beta: jax.Array) -> jax.Array:
+    """Forward: x if gate else alpha * x; backward: g if gate else beta * g.
+
+    gate is a scalar (per call, as in the reference ``shakedrop.py:14``);
+    alpha/beta are per-sample [B, 1, 1, 1].
+    """
+    return jnp.where(gate > 0.5, x, alpha * x)
+
+
+def _shake_drop_fwd(x, gate, alpha, beta):
+    return shake_drop(x, gate, alpha, beta), (gate, beta)
+
+
+def _shake_drop_bwd(res, g):
+    gate, beta = res
+    return (
+        jnp.where(gate > 0.5, g, beta * g),
+        jnp.zeros_like(gate),
+        jnp.zeros_like(beta),
+        jnp.zeros_like(beta),
+    )
+
+
+shake_drop.defvjp(_shake_drop_fwd, _shake_drop_bwd)
+
+
+def shake_drop_eval(x: jax.Array, p_drop: float) -> jax.Array:
+    """Eval path: expectation scaling by (1 - p_drop) (reference ``shakedrop.py:22``)."""
+    return (1.0 - p_drop) * x
+
+
+def sample_shake_drop_noise(key: jax.Array, batch: int, p_drop: float, dtype=jnp.float32):
+    """(gate, alpha, beta): scalar gate ~ Bernoulli(1 - p_drop) (1 = keep),
+    alpha ~ U(-1,1), beta ~ U(0,1), per-sample [B, 1, 1, 1]."""
+    kg, ka, kb = jax.random.split(key, 3)
+    shape = (batch, 1, 1, 1)
+    gate = jax.random.bernoulli(kg, 1.0 - p_drop).astype(dtype)
+    alpha = jax.random.uniform(ka, shape, dtype, minval=-1.0, maxval=1.0)
+    beta = jax.random.uniform(kb, shape, dtype)
+    return gate, alpha, beta

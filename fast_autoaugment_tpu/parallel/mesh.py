@@ -1,0 +1,102 @@
+"""Device mesh, sharding helpers and the distributed backend.
+
+The reference's distributed layer is NCCL through torch.distributed:
+one process per GPU, DDP gradient allreduce inside ``loss.backward()``,
+explicit broadcasts for init/EMA sync (``train.py:113-119,220-224``),
+plus an SSH launcher (``train_dist.py``).  The TPU-native design
+replaces ALL of that with the XLA SPMD model:
+
+- one process per HOST (``jax.distributed.initialize`` for multi-host),
+- a ``jax.sharding.Mesh`` over all devices with a ``'data'`` axis,
+- the train step jitted with the global batch sharded over ``'data'``
+  and parameters replicated: XLA inserts the gradient reductions as ICI
+  collectives automatically — there is no DDP wrapper to write, and
+  "broadcast params from rank 0" is simply device placement of the
+  replicated sharding,
+- BN statistics are computed over the global batch under jit, which is
+  exactly the cross-replica sync-BN the reference approximates with
+  ``nn.SyncBatchNorm`` / ``TpuBatchNormalization`` allreduces.
+
+NCCL-op -> XLA mapping (SURVEY.md section 5): allreduce(grads) ->
+implicit psum under jit / ``lax.psum`` under shard_map; broadcast ->
+replicated NamedSharding placement; allreduce(BN stats) -> global-batch
+statistics (or ``lax.pmean`` with an axis_name under shard_map).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "data_sharding",
+    "replicated",
+    "shard_batch",
+    "distributed_init",
+    "local_batch_to_global",
+]
+
+
+def make_mesh(devices=None, axis_name: str = "data") -> Mesh:
+    """1-D data-parallel mesh over all (or the given) devices.
+
+    Model families here are all sub-100M-param CNNs, so data parallelism
+    is the whole story (SURVEY.md section 2.3); the mesh keeps an
+    explicit axis so wider layouts can be added without API change.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices.reshape(-1), (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dimension over the data axis."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, axis_name: str = "data"):
+    """Place a host batch onto the mesh, sharded along the batch dim.
+
+    Single-process: a plain device_put of the global batch.  Multi-host:
+    each process passes its LOCAL shard (the pipeline yields per-process
+    shards) and the global array is assembled across processes — the
+    jax analog of DistributedSampler feeding per-rank loaders
+    (reference ``data.py:205-212``).
+    """
+    sharding = data_sharding(mesh, axis_name)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    def put(x):
+        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    return jax.tree.map(put, batch)
+
+
+def local_batch_to_global(batch_per_device: int, mesh: Mesh) -> int:
+    return batch_per_device * mesh.size
+
+
+def distributed_init(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None):
+    """Multi-host rendezvous (replaces torch.distributed.launch env-var
+    plumbing, reference ``train_dist.py:126-131``).  On TPU pods the
+    arguments are auto-detected from the environment."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # single-process (tests, single-chip); nothing to do
+        pass

@@ -1,0 +1,161 @@
+"""Found-policy archives and the policy codec.
+
+The reference ships its discovered augmentation policies as giant Python
+literals (`archive.py:281-293`: fa_reduced_cifar10 493 sub-policies,
+fa_resnet50_rimagenet 498, fa_reduced_svhn 497) plus AutoAugment /
+ARS-Aug paper policies remapped into the same level space
+(`archive.py:59-242`).  Policies are data, so here they live as JSON
+files under ``policies/data/`` (extracted once by
+``tools/extract_archives.py``) and the code is only the codec:
+
+- :func:`load_policy` — archive name -> list of sub-policies
+  ``[(op_name, prob, level), ...]`` with level in [0, 1];
+- :func:`policy_to_tensor` — policies -> float32 tensor
+  ``[num_sub, num_op, 3]`` of (op_idx, prob, level) rows, the form the
+  on-device augmentation engine consumes (policy-as-data: feeding a
+  different archive never recompiles anything);
+- :func:`tensor_to_policy` — inverse, for logging search results;
+- :func:`policy_decoder` — flat search-sample dict -> policies
+  (reference ``archive.py:296-307``);
+- :func:`remove_duplicates` — dedup by op-name sequence (reference
+  ``remove_deplicates``, ``archive.py:264-278``).
+
+Note: the ``arsaug`` archive is stored exactly as the reference defines
+it — with raw levels in 0..9 that the reference's own asserts would
+reject at apply time (`augmentations.py:14`); it is a dead code path
+there and is kept only for data parity.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+import numpy as np
+
+from fast_autoaugment_tpu.ops.augment import NUM_OPS, OP_NAMES, SEARCH_OP_NAMES, op_index
+
+__all__ = [
+    "ARCHIVES",
+    "load_policy",
+    "policy_to_tensor",
+    "tensor_to_policy",
+    "policy_decoder",
+    "remove_duplicates",
+    "fa_reduced_cifar10",
+    "fa_resnet50_rimagenet",
+    "fa_reduced_svhn",
+    "autoaug_policy",
+    "autoaug_paper_cifar10",
+    "arsaug_policy",
+]
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+ARCHIVES = (
+    "fa_reduced_cifar10",
+    "fa_resnet50_rimagenet",
+    "fa_reduced_svhn",
+    "autoaug_policy",
+    "autoaug_paper_cifar10",
+    "arsaug_policy",
+)
+
+Policy = list[list[tuple[str, float, float]]]
+
+
+@functools.lru_cache(maxsize=None)
+def load_policy(name: str) -> Policy:
+    """Load an archive by name; result is cached."""
+    if name not in ARCHIVES:
+        raise KeyError(f"unknown policy archive {name!r}; have {ARCHIVES}")
+    with open(os.path.join(_DATA_DIR, f"{name}.json")) as fh:
+        raw = json.load(fh)
+    return [[(str(op), float(p), float(lv)) for op, p, lv in sub] for sub in raw]
+
+
+def _archive_fn(name):
+    def fn():
+        return load_policy(name)
+
+    fn.__name__ = name
+    fn.__doc__ = f"The {name} archive (reference archive.py)."
+    return fn
+
+
+fa_reduced_cifar10 = _archive_fn("fa_reduced_cifar10")
+fa_resnet50_rimagenet = _archive_fn("fa_resnet50_rimagenet")
+fa_reduced_svhn = _archive_fn("fa_reduced_svhn")
+autoaug_policy = _archive_fn("autoaug_policy")
+autoaug_paper_cifar10 = _archive_fn("autoaug_paper_cifar10")
+arsaug_policy = _archive_fn("arsaug_policy")
+
+
+def policy_to_tensor(policies: Policy, num_op: int | None = None) -> np.ndarray:
+    """Encode policies as a float32 [num_sub, num_op, 3] tensor.
+
+    Rows are (op_idx, prob, level).  Ragged sub-policies are padded with
+    no-op rows (prob 0), which the engine skips by construction.
+    """
+    if not policies:
+        raise ValueError("empty policy list")
+    if num_op is None:
+        num_op = max(len(sub) for sub in policies)
+    out = np.zeros((len(policies), num_op, 3), np.float32)
+    for i, sub in enumerate(policies):
+        if len(sub) > num_op:
+            raise ValueError(f"sub-policy {i} has {len(sub)} ops > num_op={num_op}")
+        for j, (name, prob, level) in enumerate(sub):
+            out[i, j] = (op_index(name), prob, level)
+    return out
+
+
+def tensor_to_policy(tensor: np.ndarray) -> Policy:
+    """Inverse of :func:`policy_to_tensor` (drops prob-0 padding rows)."""
+    out: Policy = []
+    for sub in np.asarray(tensor):
+        ops = []
+        for op_idx, prob, level in sub:
+            if prob == 0.0 and level == 0.0 and op_idx == 0.0 and len(ops) > 0:
+                continue  # padding
+            ops.append((OP_NAMES[int(op_idx)], float(prob), float(level)))
+        out.append(ops)
+    return out
+
+
+def policy_decoder(augment: dict, num_policy: int, num_op: int) -> Policy:
+    """Decode a flat search sample into policies.
+
+    Mirrors the reference decoder (``archive.py:296-307``): keys
+    ``policy_{i}_{j}`` (index into the 15 searchable ops),
+    ``prob_{i}_{j}``, ``level_{i}_{j}``.
+    """
+    policies: Policy = []
+    for i in range(num_policy):
+        ops = []
+        for j in range(num_op):
+            op_idx = int(augment[f"policy_{i}_{j}"])
+            ops.append(
+                (
+                    SEARCH_OP_NAMES[op_idx],
+                    float(augment[f"prob_{i}_{j}"]),
+                    float(augment[f"level_{i}_{j}"]),
+                )
+            )
+        policies.append(ops)
+    return policies
+
+
+def remove_duplicates(policies: Policy) -> Policy:
+    """Drop sub-policies whose op-name sequence was already seen
+    (reference ``remove_deplicates``, ``archive.py:264-278``)."""
+    seen: set[str] = set()
+    out: Policy = []
+    for ops in policies:
+        key = "_".join(op[0] for op in ops)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ops)
+    return out

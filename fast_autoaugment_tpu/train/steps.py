@@ -1,0 +1,178 @@
+"""Jitted train/eval steps.
+
+One pjit-compiled function per phase is the whole training runtime —
+the analog of the reference's per-batch Python loop body
+(``run_epoch``, ``train.py:35-107``), but with augmentation, forward,
+loss (+wd), backward, clip, optimizer, EMA and metric reduction fused
+into a single XLA program over the global batch:
+
+- the global batch arrives sharded over the mesh's ``'data'`` axis;
+  params are replicated; XLA inserts gradient allreduces over ICI
+  (the DDP/NCCL equivalent, SURVEY.md section 2.2);
+- BN statistics are global-batch statistics — cross-replica BN by
+  construction (what ``tf_port/tpu_bn.py`` hand-built);
+- augmentation policies enter as TENSORS, so changing policies never
+  recompiles (the property the TTA search engine relies on);
+- EMA is a pytree lerp on device (the reference's Python-loop EMA over
+  ``state_dict`` items, ``common.py:46-51``, is a per-step host hot
+  loop — SURVEY.md section 3.1 flags it);
+- metrics leave the step as count-weighted sums, so the host only syncs
+  when it reads them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from fast_autoaugment_tpu.core.metrics import (
+    mixup_batch,
+    mixup_cross_entropy,
+    smooth_cross_entropy,
+    top_k_correct,
+)
+from fast_autoaugment_tpu.ops.optim import ema_update
+from fast_autoaugment_tpu.ops.preprocess import cifar_eval_batch, cifar_train_batch
+
+__all__ = ["TrainState", "create_train_state", "make_train_step", "make_eval_step"]
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    ema: Any  # {'params', 'batch_stats'} shadow, or None
+
+
+def create_train_state(model, optimizer, rng, sample_input, use_ema: bool) -> TrainState:
+    variables = model.init(
+        {"params": rng, "shake": jax.random.fold_in(rng, 1)}, sample_input, train=False
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    ema = {"params": params, "batch_stats": batch_stats} if use_ema else None
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+        ema=ema,
+    )
+
+
+def make_train_step(
+    model,
+    optimizer,
+    *,
+    num_classes: int,
+    mixup_alpha: float = 0.0,
+    lb_smooth: float = 0.0,
+    ema_mu: float = 0.0,
+    cutout_length: int = 16,
+    use_policy: bool = True,
+    augment_fn: Callable | None = None,
+) -> Callable:
+    """Build the jitted train step.
+
+    Returns ``step_fn(state, images_u8, labels, policy, key) ->
+    (state, metric_sums)``.  `augment_fn(images, policy, key)` defaults
+    to the CIFAR/SVHN stack; pass an ImageNet stack for that family.
+    """
+    if augment_fn is None:
+        def augment_fn(images, policy, key):
+            return cifar_train_batch(
+                images, key, policy=policy if use_policy else None,
+                cutout_length=cutout_length,
+            )
+
+    def loss_fn(params, batch_stats, images, labels, key):
+        key_mix, key_shake, key_drop = jax.random.split(key, 3)
+        apply = functools.partial(
+            model.apply,
+            {"params": params, "batch_stats": batch_stats},
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"shake": key_shake, "dropout": key_drop},
+        )
+        if mixup_alpha > 0.0:
+            mixed, targets_a, targets_b, lam = mixup_batch(key_mix, images, labels, mixup_alpha)
+            logits, mutated = apply(mixed)
+            loss = mixup_cross_entropy(logits, targets_a, targets_b, lam, lb_smooth)
+        else:
+            logits, mutated = apply(images)
+            loss = smooth_cross_entropy(logits, labels, lb_smooth)
+        return loss, (logits, mutated["batch_stats"])
+
+    @jax.jit
+    def step_fn(state: TrainState, images, labels, policy, key):
+        key_aug, key_model = jax.random.split(jax.random.fold_in(key, state.step))
+        images = augment_fn(images, policy, key_aug)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (logits, new_batch_stats)), grads = grad_fn(
+            state.params, state.batch_stats, images, labels, key_model
+        )
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+
+        new_ema = state.ema
+        if state.ema is not None and ema_mu > 0.0:
+            new_ema = ema_update(
+                state.ema,
+                {"params": new_params, "batch_stats": new_batch_stats},
+                ema_mu,
+                state.step + 1,  # 1-based, reference train.py:70
+            )
+
+        batch = labels.shape[0]
+        metrics = {
+            "loss": loss * batch,
+            "top1": top_k_correct(logits, labels, 1).astype(jnp.float32),
+            "top5": top_k_correct(logits, labels, min(5, num_classes)).astype(jnp.float32),
+            "num": jnp.float32(batch),
+        }
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_batch_stats,
+            opt_state=new_opt_state,
+            ema=new_ema,
+        )
+        return new_state, metrics
+
+    return step_fn
+
+
+def make_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
+                   preprocess_fn: Callable | None = None) -> Callable:
+    """Build the jitted eval step: ``fn(params, batch_stats, images_u8,
+    labels) -> metric_sums`` (loss/top1/top5/num as sums)."""
+    if preprocess_fn is None:
+        preprocess_fn = cifar_eval_batch
+
+    @jax.jit
+    def eval_fn(params, batch_stats, images, labels, mask):
+        """`mask` [B] of 0/1 marks real examples — eval batches are padded
+        up to a multiple of the mesh size and the padding masked out, so
+        partial final batches (reference drop_last=False eval loaders)
+        still shard evenly."""
+        images = preprocess_fn(images)
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images, train=False
+        )
+        nll = smooth_cross_entropy(logits, labels, lb_smooth, reduce_mean=False)
+        top1 = jax.lax.top_k(logits, 1)[1] == labels[:, None]
+        topk = jax.lax.top_k(logits, min(5, num_classes))[1] == labels[:, None]
+        return {
+            "loss": (nll * mask).sum(),
+            "top1": (top1.any(axis=-1) * mask).sum().astype(jnp.float32),
+            "top5": (topk.any(axis=-1) * mask).sum().astype(jnp.float32),
+            "num": mask.sum().astype(jnp.float32),
+        }
+
+    return eval_fn

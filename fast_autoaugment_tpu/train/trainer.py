@@ -1,0 +1,272 @@
+"""Epoch driver: the ``train_and_eval`` equivalent.
+
+Mirrors the reference driver's contract (``train.py:110-322``): builds
+data/model/optimizer/schedule, restores checkpoints, runs the epoch
+loop with periodic evaluation (master-only), tracks the best metric,
+reports progress to a callback (the search engine's hook,
+``train.py:289-303``) and saves checkpoints with cheap metadata.
+
+Differences by design, not omission:
+- the per-batch work is ONE jitted step on the global mesh batch (no
+  DDP wrapper, no host-side EMA loop, no H2D copy per tensor);
+- the LR schedule is a pure function of the step baked into the
+  optimizer, not a stateful scheduler stepped per batch;
+- checkpoint progress metadata is readable without deserializing
+  weights (``core/checkpoint.py``), which the search driver polls.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_autoaugment_tpu.core.checkpoint import (
+    checkpoint_exists,
+    load_checkpoint,
+    read_metadata,
+    save_checkpoint,
+)
+from fast_autoaugment_tpu.core.metrics import Accumulator
+from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
+from fast_autoaugment_tpu.data.pipeline import BatchIterator, prefetch
+from fast_autoaugment_tpu.models import get_model, num_class
+from fast_autoaugment_tpu.ops.optim import build_optimizer
+from fast_autoaugment_tpu.ops.schedules import build_schedule
+from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_batch
+from fast_autoaugment_tpu.policies.archive import load_policy, policy_to_tensor
+from fast_autoaugment_tpu.train.steps import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from fast_autoaugment_tpu.utils.logging import get_logger, make_writers
+
+__all__ = ["train_and_eval", "resolve_policy_tensor"]
+
+logger = get_logger("faa_tpu.train")
+
+
+def resolve_policy_tensor(aug: Any):
+    """conf['aug'] -> policy tensor or None ('default').
+
+    Accepts an archive name, an explicit policy list (the search's
+    decoded candidates), or 'default'/None.
+    """
+    if aug in (None, "default"):
+        return None
+    if isinstance(aug, str):
+        return jnp.asarray(policy_to_tensor(load_policy(aug)))
+    # explicit list of sub-policies
+    return jnp.asarray(policy_to_tensor([list(map(tuple, sub)) for sub in aug]))
+
+
+def _run_eval(eval_step, params, batch_stats, batches, mesh) -> dict:
+    acc = Accumulator()
+    for images, labels in batches:
+        n = len(labels)
+        pad = (-n) % mesh.size
+        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        if pad:
+            images = np.concatenate([images, np.repeat(images[-1:], pad, axis=0)])
+            labels = np.concatenate([labels, np.repeat(labels[-1:], pad, axis=0)])
+        batch = shard_batch(mesh, {"x": images, "y": labels, "m": mask})
+        acc.add_dict(eval_step(params, batch_stats, batch["x"], batch["y"], batch["m"]))
+    return acc.normalize()
+
+
+def train_and_eval(
+    conf,
+    dataroot: str,
+    *,
+    test_ratio: float = 0.0,
+    cv_fold: int = 0,
+    reporter: Callable | None = None,
+    metric: str = "last",
+    save_path: str | None = None,
+    only_eval: bool = False,
+    evaluation_interval: int = 5,
+    mesh=None,
+    seed: int = 0,
+) -> dict:
+    """Train (or just evaluate) one model under `conf`.
+
+    Returns the reference-shaped result dict with per-split loss/top1/
+    top5 plus 'epoch'.  `metric` in {'last', 'train', 'valid', 'test'}
+    selects what "best" means (reference ``train.py:286-303``).
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    is_master = jax.process_index() == 0
+
+    dataset_name = conf["dataset"]
+    num_classes = num_class(dataset_name)
+    total_train, testset = load_dataset(dataset_name, dataroot)
+
+    if test_ratio > 0.0:
+        train_idx, valid_idx = cv_split(total_train.labels, test_ratio, cv_fold)
+    else:
+        train_idx, valid_idx = np.arange(len(total_train)), np.array([], np.int64)
+    train_it = BatchIterator(total_train, train_idx)
+    valid_it = BatchIterator(total_train, valid_idx)
+    test_it = BatchIterator(testset)
+
+    batch_per_device = int(conf["batch"])
+    global_batch = batch_per_device * mesh.size
+    steps_per_epoch = max(1, len(train_idx) // global_batch)
+    epochs = int(conf["epoch"])
+
+    model = get_model(dict(conf["model"], dataset=dataset_name), num_classes)
+    lr_fn = build_schedule(conf, steps_per_epoch, world_lr_scale=float(mesh.size))
+    optimizer_conf = conf["optimizer"]
+    ema_mu = float(optimizer_conf.get("ema", 0.0) or 0.0)
+
+    from fast_autoaugment_tpu.models import input_image_size
+
+    image = input_image_size(dataset_name, conf["model"]["type"])
+    sample = jnp.zeros((2, image, image, 3), jnp.float32)
+    rng = jax.random.PRNGKey(seed)
+
+    optimizer = build_optimizer(optimizer_conf, lr_fn)
+    state = create_train_state(model, optimizer, rng, sample, use_ema=ema_mu > 0.0)
+
+    policy = resolve_policy_tensor(conf.get("aug", "default"))
+    train_step = make_train_step(
+        model,
+        optimizer,
+        num_classes=num_classes,
+        mixup_alpha=float(conf.get("mixup", 0.0) or 0.0),
+        lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
+        ema_mu=ema_mu,
+        cutout_length=int(conf.get("cutout", 0) or 0),
+        use_policy=policy is not None,
+    )
+    eval_step = make_eval_step(model, num_classes=num_classes)
+
+    writers = make_writers(
+        os.path.dirname(save_path) if save_path else None,
+        os.path.basename(save_path or "run"),
+        is_master,
+    )
+
+    epoch_start = 1
+    if save_path and checkpoint_exists(save_path):
+        meta = read_metadata(save_path) or {}
+        state = load_checkpoint(save_path, state)
+        epoch_start = int(meta.get("epoch", 0)) + 1
+        logger.info("resumed %s at epoch %d", save_path, epoch_start - 1)
+        if epoch_start > epochs:
+            only_eval = True
+    elif only_eval and save_path:
+        raise FileNotFoundError(f"--only-eval requires a checkpoint at {save_path}")
+
+    result: dict = {"epoch": epoch_start - 1}
+    best_metric = -1e9
+
+    def evaluate(tag_prefix: str, epoch: int) -> dict:
+        out = {}
+        splits = [("valid", valid_it), ("test", test_it)]
+        for split, it in splits:
+            if len(it) == 0:
+                out[split] = {"loss": 0.0, "top1": 0.0, "top5": 0.0, "num": 0}
+                continue
+            norm = _run_eval(
+                eval_step, state.params, state.batch_stats,
+                it.eval_epoch(global_batch), mesh,
+            )
+            out[split] = norm
+            if state.ema is not None:
+                norm_ema = _run_eval(
+                    eval_step, state.ema["params"], state.ema["batch_stats"],
+                    it.eval_epoch(global_batch), mesh,
+                )
+                out[split + "_ema"] = norm_ema
+        return out
+
+    if only_eval:
+        evals = evaluate("only_eval", epoch_start)
+        for split, m in evals.items():
+            for k, v in m.items():
+                result[f"{k}_{split}"] = v
+        result["epoch"] = epoch_start - 1
+        return result
+
+    t_start = time.time()
+    for epoch in range(epoch_start, epochs + 1):
+        acc = Accumulator()
+        batches = prefetch(
+            train_it.train_epoch(
+                global_batch, epoch, seed=seed,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+        )
+        for images, labels in batches:
+            batch = shard_batch(mesh, {"x": images, "y": labels})
+            pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
+            state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
+            acc.add_dict(metrics)
+        train_metrics = acc.normalize()
+        if np.isnan(train_metrics["loss"]):
+            raise RuntimeError("loss is NaN — training diverged (reference train.py:259)")
+        for k in ("loss", "top1", "top5"):
+            writers[0].add_scalar(k, train_metrics[k], epoch)
+        logger.info(
+            "[%s %3d/%3d] loss=%.4f top1=%.4f lr=%.5f",
+            "train", epoch, epochs, train_metrics["loss"], train_metrics["top1"],
+            float(lr_fn(int(state.step) - 1)),
+        )
+
+        result.update({f"{k}_train": v for k, v in train_metrics.items() if k != "num"})
+        result["epoch"] = epoch
+
+        if epoch % evaluation_interval == 0 or epoch == epochs:
+            evals = evaluate("eval", epoch)
+            for split, m in evals.items():
+                widx = 1 if split.startswith("valid") else 2
+                for k in ("loss", "top1", "top5"):
+                    writers[widx].add_scalar(f"{k}{'_ema' if split.endswith('_ema') else ''}",
+                                             m.get(k, 0.0), epoch)
+                for k, v in m.items():
+                    result[f"{k}_{split}"] = v
+                logger.info("[%s %3d/%3d] %s", split, epoch, epochs,
+                            {k: round(float(v), 4) for k, v in m.items()})
+
+            if metric == "last":
+                cur = float(epoch)
+            elif metric == "train":
+                cur = train_metrics["top1"]
+            else:
+                cur = evals.get(metric, {}).get("top1", 0.0)
+            if cur >= best_metric:
+                best_metric = cur
+                result["best_valid_top1"] = evals.get("valid", {}).get("top1", 0.0)
+                result["best_test_top1"] = evals.get("test", {}).get("top1", 0.0)
+                if save_path and is_master:
+                    save_checkpoint(
+                        save_path,
+                        state,
+                        {
+                            "epoch": epoch,
+                            "step": int(state.step),
+                            "metrics": {k: float(v) for k, v in result.items()
+                                        if isinstance(v, (int, float))},
+                        },
+                    )
+            if reporter is not None:
+                reporter(
+                    loss_valid=evals.get("valid", {}).get("loss", 0.0),
+                    top1_valid=evals.get("valid", {}).get("top1", 0.0),
+                    loss_train=train_metrics["loss"],
+                    top1_train=train_metrics["top1"],
+                    epoch=epoch,
+                )
+
+    result["elapsed_sec"] = time.time() - t_start
+    for w in writers:
+        w.close()
+    return result

@@ -9,8 +9,10 @@ cluster, SURVEY.md section 4); this is the TPU-native answer.
 
 import os
 
-# Must run before `import jax` anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before `import jax` anywhere in the test process.  The outer
+# environment pins JAX_PLATFORMS=axon (the single-chip TPU tunnel); tests
+# must NOT use it — force the virtual CPU mesh unconditionally.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

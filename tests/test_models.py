@@ -1,0 +1,156 @@
+"""Model zoo tests: parameter-count parity with the reference (counts
+extracted from the reference PyTorch modules on CPU), forward shapes,
+and the stochastic shake custom-VJPs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fast_autoaugment_tpu.models import get_model, num_class
+
+# Ground truth from /root/reference networks instantiated with torch-cpu.
+# ShakeResNet counts are the reference totals MINUS its dead parameters:
+# `self.equal_io and None or Shortcut(...)` (shake_resnet.py:17) always
+# evaluates to a Shortcut, registering shortcut modules that the forward
+# never uses on equal-io blocks (65856 params for 2x32d, 584640 for
+# 2x96d, 794976 for 2x112d).  We don't replicate dead parameters.
+REF_PARAM_COUNTS = {
+    "wresnet40_2": ("cifar10", 2246474),
+    "wresnet28_10": ("cifar10", 36489290),
+    "shakeshake26_2x32d": ("cifar10", 2923146),
+    "shakeshake26_2x96d_next": ("cifar10", 22717706),
+}
+REF_PARAM_COUNTS_SLOW = {
+    "shakeshake26_2x96d": ("cifar10", 26192906),
+    "shakeshake26_2x112d": ("cifar10", 35640426),
+    "resnet50": ("imagenet", 25557032),
+}
+
+
+def _param_count(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tree))
+
+
+def _init(model_type, dataset, image=32, model_extra=None, shapes_only=False):
+    conf = {"type": model_type, "dataset": dataset}
+    conf.update(model_extra or {})
+    model = get_model(conf, num_class(dataset))
+    x = jnp.zeros((2, image, image, 3), jnp.float32)
+    rngs = {"params": jax.random.PRNGKey(0), "shake": jax.random.PRNGKey(1)}
+    if shapes_only:
+        variables = jax.eval_shape(lambda: model.init(rngs, x, train=False))
+    else:
+        variables = model.init(rngs, x, train=False)
+    return model, variables, x
+
+
+@pytest.mark.parametrize("model_type", sorted(REF_PARAM_COUNTS))
+def test_param_counts_match_reference(model_type):
+    dataset, want = REF_PARAM_COUNTS[model_type]
+    _, variables, _ = _init(model_type, dataset, shapes_only=True)
+    assert _param_count(variables["params"]) == want
+
+
+@pytest.mark.parametrize("model_type", sorted(REF_PARAM_COUNTS_SLOW))
+def test_param_counts_match_reference_slow(model_type):
+    dataset, want = REF_PARAM_COUNTS_SLOW[model_type]
+    image = 224 if dataset == "imagenet" else 32
+    _, variables, _ = _init(model_type, dataset, image, shapes_only=True)
+    assert _param_count(variables["params"]) == want
+
+
+def test_pyramidnet_param_count_matches_reference():
+    _, variables, _ = _init(
+        "pyramid", "cifar10",
+        model_extra={"depth": 272, "alpha": 200, "bottleneck": True},
+        shapes_only=True,
+    )
+    assert _param_count(variables["params"]) == 26210842
+
+
+@pytest.mark.parametrize(
+    "model_type,extra",
+    [
+        ("wresnet40_2", None),
+        ("shakeshake26_2x32d", None),
+        ("pyramid", {"depth": 29, "alpha": 48, "bottleneck": True}),
+    ],
+)
+def test_forward_shapes_and_train_mode(model_type, extra):
+    model, variables, x = _init(model_type, "cifar10", model_extra=extra)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    out, mutated = model.apply(
+        variables,
+        x,
+        train=True,
+        mutable=["batch_stats"],
+        rngs={"shake": jax.random.PRNGKey(2), "dropout": jax.random.PRNGKey(3)},
+    )
+    assert out.shape == (2, 10)
+    # batch stats actually updated
+    old = jax.tree.leaves(variables["batch_stats"])
+    new = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_resnet_cifar_variant():
+    model, variables, x = _init("wresnet40_2", "cifar100")
+    assert model.apply(variables, x, train=False).shape == (2, 100)
+
+
+# ---------------------------------------------------------------------------
+# shake custom VJPs: independent forward/backward randomness
+# ---------------------------------------------------------------------------
+
+
+def test_shake_shake_forward_and_backward_noise():
+    from fast_autoaugment_tpu.ops.shake import shake_shake
+
+    x1 = jnp.ones((4, 2, 2, 3))
+    x2 = jnp.zeros((4, 2, 2, 3))
+    alpha = jnp.array([0.0, 0.25, 0.5, 1.0]).reshape(4, 1, 1, 1)
+    beta = jnp.array([1.0, 0.75, 0.5, 0.0]).reshape(4, 1, 1, 1)
+
+    out, vjp = jax.vjp(lambda a, b: shake_shake(a, b, alpha, beta), x1, x2)
+    np.testing.assert_allclose(np.asarray(out[:, 0, 0, 0]), [0.0, 0.25, 0.5, 1.0])
+    g1, g2 = vjp(jnp.ones_like(out))
+    # backward must use beta, NOT alpha
+    np.testing.assert_allclose(np.asarray(g1[:, 0, 0, 0]), [1.0, 0.75, 0.5, 0.0])
+    np.testing.assert_allclose(np.asarray(g2[:, 0, 0, 0]), [0.0, 0.25, 0.5, 1.0])
+
+
+def test_shake_drop_gate_semantics():
+    from fast_autoaugment_tpu.ops.shake import shake_drop
+
+    x = jnp.full((2, 1, 1, 1), 3.0)
+    alpha = jnp.full((2, 1, 1, 1), -0.5)
+    beta = jnp.full((2, 1, 1, 1), 0.25)
+
+    # gate = 1 (keep): identity fwd, identity bwd
+    out, vjp = jax.vjp(lambda v: shake_drop(v, jnp.float32(1.0), alpha, beta), x)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    np.testing.assert_allclose(np.asarray(vjp(jnp.ones_like(out))[0]), 1.0)
+
+    # gate = 0 (drop): alpha fwd, beta bwd
+    out, vjp = jax.vjp(lambda v: shake_drop(v, jnp.float32(0.0), alpha, beta), x)
+    np.testing.assert_allclose(np.asarray(out), -1.5)
+    np.testing.assert_allclose(np.asarray(vjp(jnp.ones_like(out))[0]), 0.25)
+
+
+def test_shake_ops_work_under_jit_and_grad():
+    from fast_autoaugment_tpu.ops.shake import (
+        sample_shake_shake_noise,
+        shake_shake,
+    )
+
+    @jax.jit
+    def loss_fn(x1, x2, key):
+        alpha, beta = sample_shake_shake_noise(key, x1.shape[0])
+        return shake_shake(x1, x2, alpha, beta).sum()
+
+    g = jax.grad(loss_fn)(jnp.ones((3, 2, 2, 1)), jnp.ones((3, 2, 2, 1)), jax.random.PRNGKey(0))
+    assert g.shape == (3, 2, 2, 1)
+    assert np.isfinite(np.asarray(g)).all()

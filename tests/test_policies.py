@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.ops.augment import OP_NAMES, SEARCH_OP_NAMES
+from fast_autoaugment_tpu.policies import archive as P
+
+
+def test_archive_counts_match_reference():
+    # reference archive.py:281-293
+    assert len(P.fa_reduced_cifar10()) == 493
+    assert len(P.fa_resnet50_rimagenet()) == 498
+    assert len(P.fa_reduced_svhn()) == 497
+    assert len(P.autoaug_paper_cifar10()) == 25
+    assert len(P.autoaug_policy()) == 95
+    assert len(P.arsaug_policy()) == 35
+
+
+def test_all_ops_known_and_levels_in_range():
+    for name in ("fa_reduced_cifar10", "fa_resnet50_rimagenet", "fa_reduced_svhn"):
+        for sub in P.load_policy(name):
+            assert len(sub) == 2
+            for op, prob, level in sub:
+                assert op in OP_NAMES
+                assert 0.0 <= prob <= 1.0
+                assert 0.0 <= level <= 1.0
+
+
+def test_tensor_roundtrip():
+    pol = P.fa_reduced_cifar10()[:10]
+    t = P.policy_to_tensor(pol)
+    assert t.shape == (10, 2, 3) and t.dtype == np.float32
+    back = P.tensor_to_policy(t)
+    for sub, subb in zip(pol, back):
+        for (n1, p1, l1), (n2, p2, l2) in zip(sub, subb):
+            assert n1 == n2
+            assert p1 == pytest.approx(p2, abs=1e-6)
+            assert l1 == pytest.approx(l2, abs=1e-6)
+
+
+def test_policy_decoder_matches_reference_semantics():
+    augment = {}
+    for i in range(2):
+        for j in range(2):
+            augment[f"policy_{i}_{j}"] = (i * 2 + j) % len(SEARCH_OP_NAMES)
+            augment[f"prob_{i}_{j}"] = 0.25 * (i + 1)
+            augment[f"level_{i}_{j}"] = 0.1 * (j + 1)
+    pol = P.policy_decoder(augment, 2, 2)
+    assert pol == [
+        [("ShearX", 0.25, 0.1), ("ShearY", 0.25, 0.2)],
+        [("TranslateX", 0.5, 0.1), ("TranslateY", 0.5, 0.2)],
+    ]
+
+
+def test_remove_duplicates_keys_on_names_only():
+    pol = [
+        [("ShearX", 0.1, 0.1), ("Rotate", 0.2, 0.2)],
+        [("ShearX", 0.9, 0.9), ("Rotate", 0.8, 0.8)],  # same names -> dropped
+        [("Rotate", 0.1, 0.1), ("ShearX", 0.2, 0.2)],  # different order -> kept
+    ]
+    out = P.remove_duplicates(pol)
+    assert len(out) == 2
+    assert out[0][0][1] == 0.1  # first occurrence wins
+
+
+def test_unknown_archive_raises():
+    with pytest.raises(KeyError):
+        P.load_policy("nope")

@@ -1,0 +1,63 @@
+"""One-time extraction of the found-policy archives into JSON data files.
+
+The reference ships its discovered policies as giant Python literals
+(`/root/reference/FastAutoAugment/archive.py:281-293`) plus the
+AutoAugment/ARS-Aug paper policies remapped through `autoaug2arsaug`
+(`archive.py:59-87`).  Policies are *data*, not code — the TPU framework
+stores them as JSON under `fast_autoaugment_tpu/policies/data/` and owns
+its own codec.  This tool evaluates the reference module once (with its
+torch/torchvision imports stubbed out) and dumps each policy list.
+
+Run: python tools/extract_archives.py
+"""
+
+import json
+import os
+import sys
+import types
+
+REF = "/root/reference"
+OUT = os.path.join(os.path.dirname(__file__), "..", "fast_autoaugment_tpu", "policies", "data")
+
+
+def _stub(name, **attrs):
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules[name] = mod
+    return mod
+
+
+def main():
+    # Stub the heavyweight imports augmentations.py pulls in; none are used
+    # by the policy data itself.
+    _stub("torch", Tensor=object)
+    _stub("torchvision")
+    _stub("torchvision.transforms")
+    _stub("torchvision.transforms.transforms", Compose=object)
+
+    sys.path.insert(0, REF)
+    from FastAutoAugment import archive  # noqa: E402
+
+    os.makedirs(OUT, exist_ok=True)
+    names = [
+        "fa_reduced_cifar10",
+        "fa_resnet50_rimagenet",
+        "fa_reduced_svhn",
+        "autoaug_policy",
+        "autoaug_paper_cifar10",
+        "arsaug_policy",
+    ]
+    for name in names:
+        policies = getattr(archive, name)()
+        # normalize: list of sub-policies; each sub-policy is a list of
+        # [op_name, prob, level] with level already in [0, 1]
+        data = [[[str(op), float(p), float(lv)] for op, p, lv in sub] for sub in policies]
+        path = os.path.join(OUT, f"{name}.json")
+        with open(path, "w") as fh:
+            json.dump(data, fh)
+        print(f"{name}: {len(data)} sub-policies -> {path}")
+
+
+if __name__ == "__main__":
+    main()
