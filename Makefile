@@ -1,0 +1,13 @@
+.PHONY: test native bench clean
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C native clean

@@ -1,0 +1,147 @@
+"""ctypes wrapper for the native C++ data loader (``native/faa_loader.cpp``).
+
+The reference's host-side throughput comes from 8 DataLoader worker
+processes per GPU running PIL (``data.py:214-224``).  The in-tree
+equivalent is a C++ thread pool (libjpeg decode + crop + bilinear
+resize into one contiguous batch buffer) loaded here via ctypes — no
+pybind11 dependency, graceful fallback to the PIL path when the shared
+library hasn't been built (``make -C native``).
+
+The native path is a throughput engine; PIL remains the golden-parity
+decoder (bicubic vs bilinear resize).  Enable/disable explicitly with
+``FAA_NATIVE_LOADER=0/1`` or let :func:`available` auto-detect.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+__all__ = ["available", "build", "decode_resize_batch", "gather_u8"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libfaa_loader.so"))
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("FAA_NATIVE_LOADER", "1") == "0":
+        return None
+    if not os.path.exists(_SO_PATH):
+        return None
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.faa_decode_resize_batch.restype = ctypes.c_int
+    lib.faa_decode_resize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+        ctypes.c_void_p,  # boxes (float* or NULL)
+        ctypes.c_int,
+        ctypes.c_void_p,  # out
+        ctypes.c_int,
+    ]
+    lib.faa_gather_u8.restype = None
+    lib.faa_gather_u8.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    lib.faa_image_size.restype = ctypes.c_int
+    lib.faa_image_size.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    _lib = lib
+    return lib
+
+
+def image_size(path) -> tuple[int, int] | None:
+    """(width, height) from the JPEG header, or None on failure."""
+    lib = _load()
+    if lib is None:
+        return None
+    w, h = ctypes.c_int(0), ctypes.c_int(0)
+    if lib.faa_image_size(os.fsencode(str(path)), ctypes.byref(w), ctypes.byref(h)):
+        return None
+    return int(w.value), int(h.value)
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the shared library via the native Makefile."""
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            check=True,
+            capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    global _lib
+    _lib = None
+    return available()
+
+
+def decode_resize_batch(paths, target: int, boxes: np.ndarray | None = None,
+                        threads: int | None = None) -> tuple[np.ndarray, int]:
+    """Decode `paths` (JPEG files) into a [N, target, target, 3] uint8
+    batch; `boxes` is an optional [N, 4] float32 of crop boxes.  Returns
+    (batch, num_failures); failed slots are zero-filled."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader not built; run make -C native")
+    n = len(paths)
+    out = np.empty((n, target, target, 3), np.uint8)
+    c_paths = (ctypes.c_char_p * n)(*[os.fsencode(str(p)) for p in paths])
+    boxes_ptr = None
+    if boxes is not None:
+        boxes = np.ascontiguousarray(boxes, np.float32)
+        assert boxes.shape == (n, 4)
+        boxes_ptr = boxes.ctypes.data_as(ctypes.c_void_p)
+    threads = threads or min(16, os.cpu_count() or 1)
+    failures = lib.faa_decode_resize_batch(
+        c_paths, n, boxes_ptr, target,
+        out.ctypes.data_as(ctypes.c_void_p), threads,
+    )
+    return out, int(failures)
+
+
+def gather_u8(src: np.ndarray, index: np.ndarray, threads: int | None = None) -> np.ndarray:
+    """Parallel batch gather: out[i] = src[index[i]] (contiguous uint8 rows)."""
+    lib = _load()
+    if lib is None:
+        return src[index]
+    src = np.ascontiguousarray(src)
+    index = np.ascontiguousarray(index, np.int64)
+    item_bytes = int(np.prod(src.shape[1:])) * src.itemsize
+    out = np.empty((len(index),) + src.shape[1:], src.dtype)
+    threads = threads or min(16, os.cpu_count() or 1)
+    lib.faa_gather_u8(
+        src.ctypes.data_as(ctypes.c_void_p),
+        index.ctypes.data_as(ctypes.c_void_p),
+        len(index),
+        item_bytes,
+        out.ctypes.data_as(ctypes.c_void_p),
+        threads,
+    )
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    ok = build(quiet=False)
+    print("native loader built:", ok, "->", _SO_PATH)
+    sys.exit(0 if ok else 1)

@@ -29,15 +29,77 @@ from fast_autoaugment_tpu.data.datasets import ArrayDataset
 __all__ = ["BatchIterator", "train_batches", "eval_batches", "prefetch"]
 
 
-def _decode(paths: np.ndarray, size: int | None) -> np.ndarray:
-    """Decode a batch of image files to uint8 NHWC (lazy datasets)."""
+def _decode(paths: np.ndarray, transform=None, size: int | None = None) -> np.ndarray:
+    """Decode a batch of image files to uint8 NHWC (lazy datasets).
+
+    `transform(pil_image) -> np.uint8 [S, S, 3]` handles per-image
+    host-side geometry (e.g. the ImageNet random/center crop + bicubic
+    resize); without one, a plain bicubic resize to `size` is applied.
+    """
     import PIL.Image
 
     out = []
     for p in paths:
         img = PIL.Image.open(p).convert("RGB")
-        if size is not None:
-            img = img.resize((size, size), PIL.Image.BICUBIC)
+        if transform is not None:
+            out.append(transform(img))
+        else:
+            if size is not None:
+                img = img.resize((size, size), PIL.Image.BICUBIC)
+            out.append(np.asarray(img, np.uint8))
+    return np.stack(out)
+
+
+class SizeCache:
+    """Lazy per-path (width, height) cache for boxed decoding."""
+
+    def __init__(self):
+        self._sizes: dict = {}
+
+    def get(self, path) -> tuple[int, int]:
+        got = self._sizes.get(path)
+        if got is None:
+            from fast_autoaugment_tpu.data import native_loader
+
+            got = native_loader.image_size(path)
+            if got is None:
+                import PIL.Image
+
+                with PIL.Image.open(path) as img:  # header-only read
+                    got = img.size
+            self._sizes[path] = got
+        return got
+
+
+def _decode_boxed(paths, imgsize: int, box_fn, rng, size_cache: SizeCache) -> np.ndarray:
+    """Decode + crop(box_fn) + resize a batch.
+
+    Uses the native C++ loader (one threaded pass: libjpeg decode, crop,
+    triangle resample) when built; falls back to PIL (bicubic, the
+    golden-parity path).  `box_fn(rng, width, height) -> (x0, y0, x1, y1)`.
+    """
+    from fast_autoaugment_tpu.data import native_loader
+
+    boxes = np.empty((len(paths), 4), np.float32)
+    for i, p in enumerate(paths):
+        w, h = size_cache.get(p)
+        boxes[i] = box_fn(rng, w, h)
+    if native_loader.available():
+        batch, failures = native_loader.decode_resize_batch(paths, imgsize, boxes)
+        if failures:
+            import logging
+
+            logging.getLogger("faa_tpu.data").warning(
+                "native loader: %d/%d images failed to decode (zero-filled)",
+                failures, len(paths),
+            )
+        return batch
+    import PIL.Image
+
+    out = []
+    for p, box in zip(paths, boxes):
+        img = PIL.Image.open(p).convert("RGB")
+        img = img.crop(tuple(box)).resize((imgsize, imgsize), PIL.Image.BICUBIC)
         out.append(np.asarray(img, np.uint8))
     return np.stack(out)
 
@@ -52,24 +114,38 @@ def train_batches(
     process_index: int = 0,
     process_count: int = 1,
     decode_size: int | None = None,
+    host_transform=None,
+    box_fn=None,
+    imgsize: int | None = None,
+    size_cache: "SizeCache | None" = None,
 ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Shuffled, drop-last train batches for one epoch.
 
     `indices` restricts to a subset (CV fold); each process yields its
     [process_index] shard of every global batch, so all hosts stay in
-    step for the pjit'd global-batch train step.
+    step for the pjit'd global-batch train step.  For lazy datasets,
+    either `box_fn(rng, w, h) -> crop box` + `imgsize` (native-loader
+    fast path) or `host_transform(pil_image, rng) -> uint8 array` runs
+    per image.
     """
     idx = np.arange(len(dataset)) if indices is None else np.asarray(indices)
     rng = np.random.default_rng((seed, epoch))
     idx = rng.permutation(idx)
     steps = len(idx) // global_batch
     shard = global_batch // process_count
+    transform = None
+    if host_transform is not None:
+        transform = lambda img: host_transform(img, rng)  # noqa: E731
     for s in range(steps):
         chunk = idx[s * global_batch:(s + 1) * global_batch]
         chunk = chunk[process_index * shard:(process_index + 1) * shard]
         images = dataset.images[chunk]
         if dataset.lazy:
-            images = _decode(images, decode_size)
+            if box_fn is not None:
+                images = _decode_boxed(images, imgsize, box_fn, rng,
+                                       size_cache or SizeCache())
+            else:
+                images = _decode(images, transform, decode_size)
         yield images, dataset.labels[chunk]
 
 
@@ -79,15 +155,24 @@ def eval_batches(
     batch: int,
     *,
     decode_size: int | None = None,
+    host_transform=None,
+    box_fn=None,
+    imgsize: int | None = None,
+    size_cache: "SizeCache | None" = None,
 ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Deterministic eval batches (SubsetSampler semantics,
     ``data.py:348-362``); final partial batch kept."""
     idx = np.arange(len(dataset)) if indices is None else np.asarray(indices)
+    rng = np.random.default_rng(0)  # eval box_fns ignore the rng
     for s in range(0, len(idx), batch):
         chunk = idx[s:s + batch]
         images = dataset.images[chunk]
         if dataset.lazy:
-            images = _decode(images, decode_size)
+            if box_fn is not None:
+                images = _decode_boxed(images, imgsize, box_fn, rng,
+                                       size_cache or SizeCache())
+            else:
+                images = _decode(images, host_transform, decode_size)
         yield images, dataset.labels[chunk]
 
 
@@ -122,12 +207,21 @@ def prefetch(iterator, depth: int = 2):
 
 
 class BatchIterator:
-    """Convenience wrapper bundling a dataset + fold indices."""
+    """Convenience wrapper bundling a dataset + fold indices + host-side
+    crop semantics (used only for lazy, on-disk datasets)."""
 
-    def __init__(self, dataset: ArrayDataset, indices=None, decode_size=None):
+    def __init__(self, dataset: ArrayDataset, indices=None, decode_size=None,
+                 train_transform=None, eval_transform=None,
+                 train_box_fn=None, eval_box_fn=None, imgsize=None):
         self.dataset = dataset
         self.indices = indices
         self.decode_size = decode_size
+        self.train_transform = train_transform
+        self.eval_transform = eval_transform
+        self.train_box_fn = train_box_fn
+        self.eval_box_fn = eval_box_fn
+        self.imgsize = imgsize
+        self.size_cache = SizeCache()
 
     def __len__(self):
         return len(self.indices) if self.indices is not None else len(self.dataset)
@@ -135,10 +229,15 @@ class BatchIterator:
     def train_epoch(self, global_batch, epoch, **kw):
         return train_batches(
             self.dataset, self.indices, global_batch, epoch,
-            decode_size=self.decode_size, **kw,
+            decode_size=self.decode_size, host_transform=self.train_transform,
+            box_fn=self.train_box_fn, imgsize=self.imgsize,
+            size_cache=self.size_cache, **kw,
         )
 
     def eval_epoch(self, batch):
         return eval_batches(
-            self.dataset, self.indices, batch, decode_size=self.decode_size
+            self.dataset, self.indices, batch, decode_size=self.decode_size,
+            host_transform=self.eval_transform,
+            box_fn=self.eval_box_fn, imgsize=self.imgsize,
+            size_cache=self.size_cache,
         )

@@ -1,0 +1,159 @@
+"""Multi-host fleet launcher + process supervision.
+
+Replaces the reference's ``train_dist.py`` (SSH loop wrapping
+``torch.distributed.launch``, ``train_dist.py:105-143``) and its
+Horovod-derived ``safe_shell_exec.py`` process supervisor.  Because JAX
+is multi-controller, every host simply runs the SAME command with its
+``--host-id``; there is no per-GPU process fan-out to babysit.
+
+What remains worth keeping from the reference's design is the process
+hygiene, provided here natively:
+
+- every remote command runs under ``setsid`` so the whole remote
+  process TREE dies with one signal (the reference's fork-middleman
+  trick, ``safe_shell_exec.py:29-60``);
+- local SIGINT/SIGTERM (and normal exit) fan out kills to every host;
+- remote stdout/stderr is streamed line-by-line with a ``[host]``
+  prefix (``safe_shell_exec.py:63-87``);
+- non-zero exit on any host tears the fleet down and propagates the
+  exit code (``train_dist.py:15-27``).
+
+    python -m fast_autoaugment_tpu.launch.fleet --hosts host1,host2,host3,host4 \
+        --coordinator host1:8476 -- python -m fast_autoaugment_tpu.launch.train_cli \
+        -c confs/resnet50.yaml --dataroot /data
+
+``--hosts N`` expands to task1..taskN like the reference
+(``train_dist.py:118-121``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+logger = get_logger("faa_tpu.fleet")
+
+__all__ = ["expand_hosts", "launch_fleet", "main"]
+
+
+def expand_hosts(spec: str) -> list[str]:
+    """'4' -> [task1..task4]; 'a,b,c' -> [a, b, c] (train_dist.py:118-121)."""
+    if spec.isdigit():
+        return [f"task{i + 1}" for i in range(int(spec))]
+    return [h.strip() for h in spec.split(",") if h.strip()]
+
+
+class _Fleet:
+    def __init__(self):
+        self.procs: list[subprocess.Popen] = []
+        self.failed: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def kill_all(self, sig=signal.SIGTERM):
+        with self._lock:
+            for p in self.procs:
+                if p.poll() is None:
+                    try:
+                        # the local ssh runs in its own session; killing it
+                        # drops the connection, and the remote setsid group
+                        # dies with the controlling terminal
+                        os.killpg(os.getpgid(p.pid), sig)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+
+
+def _stream(host: str, pipe, out):
+    for line in iter(pipe.readline, b""):
+        out.write(f"[{host}] ".encode() + line)
+        out.flush()
+    pipe.close()
+
+
+def launch_fleet(hosts: list[str], command: list[str], coordinator: str | None,
+                 env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",)) -> int:
+    """Run `command` on every host over SSH; returns the worst exit code."""
+    fleet = _Fleet()
+    coordinator = coordinator or f"{hosts[0]}:8476"
+
+    def handler(signum, frame):
+        logger.info("signal %d: killing fleet", signum)
+        fleet.kill_all(signal.SIGTERM)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+    threads = []
+    for host_id, host in enumerate(hosts):
+        remote_cmd = command + [
+            "--coordinator", coordinator,
+            "--num-hosts", str(len(hosts)),
+            "--host-id", str(host_id),
+        ]
+        envs = " ".join(
+            f"{k}={shlex.quote(os.environ[k])}" for k in env_passthrough if k in os.environ
+        )
+        # setsid so the remote tree is one killable group; ssh -tt ties its
+        # lifetime to ours (safe_shell_exec.py:98-105 equivalent)
+        wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec setsid " + " ".join(
+            shlex.quote(c) for c in remote_cmd
+        )
+        full = ["ssh", "-tt", "-o", "BatchMode=yes", host, wire]
+        logger.info("[%s] %s", host, " ".join(full))
+        try:
+            p = subprocess.Popen(
+                full, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        except FileNotFoundError:
+            logger.error("ssh binary not found — the fleet launcher needs an "
+                         "ssh client on the controlling host")
+            fleet.kill_all()
+            return 127
+        fleet.procs.append(p)
+        t = threading.Thread(target=_stream, args=(host, p.stdout, sys.stdout.buffer),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    worst = 0
+    try:
+        for host, p in zip(hosts, fleet.procs):
+            code = p.wait()
+            if code != 0:
+                logger.warning("[%s] exited %d — tearing down fleet", host, code)
+                worst = worst or code
+                fleet.kill_all()
+    finally:
+        fleet.kill_all()
+        for t in threads:
+            t.join(timeout=2)
+    return worst
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="multi-host launcher")
+    p.add_argument("--hosts", required=True, help="N or comma-separated hostnames")
+    p.add_argument("--coordinator", default=None, help="addr:port of host 0")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host (prefix with --)")
+    args = p.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        p.error("no command given")
+    hosts = expand_hosts(args.hosts)
+    code = launch_fleet(hosts, command, args.coordinator)
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

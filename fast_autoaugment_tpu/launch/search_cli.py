@@ -1,0 +1,112 @@
+"""Policy-search CLI — the ``python search.py -c conf.yaml --redis ...``
+equivalent (reference ``search.py:137-154``) without Ray/Redis.
+
+    python -m fast_autoaugment_tpu.launch.search_cli -c confs/wresnet40x2_cifar.yaml \
+        --dataroot /data --save-dir search_out --smoke-test
+
+Runs phases 1+2 (K-fold no-aug pretrain, TPE TTA search) and then
+phase 3 (``--num-result-per-cv`` full retrains with default vs found
+policies, averaged — reference ``search.py:264-312``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from fast_autoaugment_tpu.core.config import load_config
+from fast_autoaugment_tpu.search.driver import search_policies
+from fast_autoaugment_tpu.train.trainer import train_and_eval
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+logger = get_logger("faa_tpu.search_cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="fast-autoaugment-tpu policy search")
+    p.add_argument("-c", "--conf", required=True)
+    p.add_argument("--dataroot", default="./data")
+    p.add_argument("--save-dir", default="search_out")
+    p.add_argument("--num-fold", type=int, default=5, help="K (reference cv_num=5)")
+    p.add_argument("--cv-ratio", type=float, default=0.4)
+    p.add_argument("--num-policy", type=int, default=5)
+    p.add_argument("--num-op", type=int, default=2)
+    p.add_argument("--num-search", type=int, default=200)
+    p.add_argument("--num-top", type=int, default=10)
+    p.add_argument("--num-result-per-cv", type=int, default=5,
+                   help="phase-3 retrains per mode (reference search.py:270)")
+    p.add_argument("--until", type=int, default=3,
+                   help="run phases up to this number (1, 2 or 3)")
+    p.add_argument("--smoke-test", action="store_true")
+    p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("override", nargs="*")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    conf = load_config(args.conf, overrides=args.override)
+    t_start = time.time()
+
+    result = search_policies(
+        conf,
+        dataroot=args.dataroot,
+        save_dir=args.save_dir,
+        cv_num=args.num_fold,
+        cv_ratio=args.cv_ratio,
+        num_policy=args.num_policy,
+        num_op=args.num_op,
+        num_search=args.num_search,
+        num_top=args.num_top,
+        smoke_test=args.smoke_test,
+        resume=not args.no_resume,
+        until=args.until,
+        seed=args.seed,
+    )
+    final_policy_set = result["final_policy_set"]
+    logger.info("final policy set: %d sub-policies", len(final_policy_set))
+    if args.until < 3 or not final_policy_set:
+        import jax
+
+        result["tpu_hours_total"] = (time.time() - t_start) * jax.device_count() / 3600.0
+        with open(f"{args.save_dir}/search_result.json", "w") as fh:
+            json.dump({k: v for k, v in result.items() if k != "final_policy_set"}, fh)
+        return result
+
+    if args.until >= 3:
+        # phase 3: full retrains default vs augmented (search.py:264-312)
+        num_runs = 1 if args.smoke_test else args.num_result_per_cv
+        outcomes = {"default": [], "augment": []}
+        for mode, aug in (("default", "default"), ("augment", final_policy_set)):
+            for run in range(num_runs):
+                mode_conf = conf.replace(aug=aug)
+                path = f"{args.save_dir}/final_{mode}_{run}.msgpack"
+                res = train_and_eval(
+                    mode_conf, args.dataroot, test_ratio=0.0,
+                    save_path=path, metric="last", seed=args.seed + run,
+                )
+                outcomes[mode].append(res.get("top1_test", 0.0))
+                logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,
+                            outcomes[mode][-1])
+        result["top1_test_default_mean"] = float(np.mean(outcomes["default"]))
+        result["top1_test_augment_mean"] = float(np.mean(outcomes["augment"]))
+        logger.info(
+            "phase3: default %.4f vs augmented %.4f",
+            result["top1_test_default_mean"], result["top1_test_augment_mean"],
+        )
+
+    import jax
+
+    result["tpu_hours_total"] = (time.time() - t_start) * jax.device_count() / 3600.0
+    with open(f"{args.save_dir}/search_result.json", "w") as fh:
+        json.dump({k: v for k, v in result.items() if k != "final_policy_set"}, fh)
+    logger.info("search complete: %.3f TPU-hours", result["tpu_hours_total"])
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Training CLI — the ``python FastAutoAugment/train.py -c conf.yaml``
+equivalent (reference ``train.py:325-356``).
+
+    python -m fast_autoaugment_tpu.launch.train_cli -c confs/wresnet40x2_cifar.yaml \
+        --dataroot /data --save ckpt/wrn.msgpack --tag wrn40x2
+
+Multi-host: run the SAME command on every host (JAX multi-controller;
+``--coordinator host0:1234 --num-hosts N --host-id k`` or TPU-pod
+auto-detection) — there is no torch.distributed.launch equivalent to
+wrangle, which is the point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from fast_autoaugment_tpu.core.config import load_config
+from fast_autoaugment_tpu.train.trainer import train_and_eval
+from fast_autoaugment_tpu.utils.logging import add_filehandler, get_logger
+
+logger = get_logger("faa_tpu.train_cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="fast-autoaugment-tpu trainer")
+    p.add_argument("-c", "--conf", required=True, help="YAML preset (confs/*.yaml)")
+    p.add_argument("--dataroot", default="./data")
+    p.add_argument("--save", default="", help="checkpoint path (.msgpack)")
+    p.add_argument("--tag", default="")
+    p.add_argument("--cv-ratio", type=float, default=0.0)
+    p.add_argument("--cv", type=int, default=0, help="CV resample index")
+    p.add_argument("--only-eval", action="store_true")
+    p.add_argument("--evaluation-interval", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--coordinator", default=None, help="host0 addr for multi-host")
+    p.add_argument("--num-hosts", type=int, default=None)
+    p.add_argument("--host-id", type=int, default=None)
+    p.add_argument("override", nargs="*", help="dotted conf overrides key=value")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.coordinator:
+        from fast_autoaugment_tpu.parallel.mesh import distributed_init
+
+        distributed_init(args.coordinator, args.num_hosts, args.host_id)
+
+    conf = load_config(args.conf, overrides=args.override)
+    if args.tag:
+        add_filehandler(logger, f"train_{args.tag}.log")
+    if args.only_eval and not args.save:
+        logger.warning("--only-eval requires --save (reference train.py:337)")
+        raise SystemExit(1)
+
+    t0 = time.time()
+    result = train_and_eval(
+        conf,
+        args.dataroot,
+        test_ratio=args.cv_ratio,
+        cv_fold=args.cv,
+        save_path=args.save or None,
+        only_eval=args.only_eval,
+        evaluation_interval=args.evaluation_interval,
+        metric="last",
+        seed=args.seed,
+    )
+    elapsed = time.time() - t0
+    logger.info("done %s: %s", args.tag, json.dumps(
+        {k: round(v, 5) if isinstance(v, float) else v for k, v in result.items()}))
+    logger.info("elapsed: %.1f s (%.2f h)", elapsed, elapsed / 3600.0)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,244 @@
+"""Three-phase policy search by density matching.
+
+The reference's ``search.py:137-312``: (1) pretrain K=5 models on CV
+resamples WITHOUT augmentation, (2) per fold run HyperOpt-TPE over
+{op, prob, level}^(num_policy x num_op) with test-time-augmentation
+reward against the held-out fold, keep each fold's top-10 samples,
+decode + dedup into ``final_policy_set``, (3) retrain on the full data
+with and without the found policies and compare.
+
+Differences by design:
+- Ray remotes + Redis + checkpoint-polling progress threads become a
+  plain in-process loop around ONE compiled TTA step per fold; trial
+  state is a JSON file, resumable (`--resume` parity) and readable by
+  the launcher for multi-host fold sharding (fold k -> host k % n).
+- TPE is in-tree (``search/tpe.py``).
+- "GPU-hours" accounting (``search.py:132-133,251``) becomes
+  TPU-seconds = wall x device_count, reported per phase.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_autoaugment_tpu.core.checkpoint import load_checkpoint, read_metadata
+from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
+from fast_autoaugment_tpu.models import get_model, num_class
+from fast_autoaugment_tpu.ops.augment import SEARCH_OP_NAMES
+from fast_autoaugment_tpu.parallel.mesh import make_mesh
+from fast_autoaugment_tpu.policies.archive import (
+    policy_decoder,
+    policy_to_tensor,
+    remove_duplicates,
+)
+from fast_autoaugment_tpu.search.tpe import TPE, choice, uniform
+from fast_autoaugment_tpu.search.tta import eval_tta, make_tta_step
+from fast_autoaugment_tpu.train.trainer import train_and_eval
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = ["search_policies", "make_search_space", "SearchResult"]
+
+logger = get_logger("faa_tpu.search")
+
+
+def make_search_space(num_policy: int, num_op: int):
+    """The reference's search space (``search.py:214-220``): per (i, j)
+    an op choice over the 15 searchable ops, prob ~ U(0,1), level ~ U(0,1)."""
+    space = []
+    for i in range(num_policy):
+        for j in range(num_op):
+            space.append(choice(f"policy_{i}_{j}", len(SEARCH_OP_NAMES)))
+            space.append(uniform(f"prob_{i}_{j}", 0, 1))
+            space.append(uniform(f"level_{i}_{j}", 0, 1))
+    return space
+
+
+class SearchResult(dict):
+    @property
+    def final_policy_set(self):
+        return self["final_policy_set"]
+
+
+def _fold_ckpt_path(save_dir: str, conf, fold: int, cv_ratio: float) -> str:
+    tag = f"{conf['model']['type']}_{conf['dataset']}_fold{fold}_ratio{cv_ratio:.2f}"
+    return os.path.join(save_dir, f"{tag}.msgpack")
+
+
+def search_policies(
+    conf,
+    dataroot: str,
+    save_dir: str,
+    *,
+    cv_num: int = 5,
+    cv_ratio: float = 0.4,
+    num_policy: int = 5,
+    num_op: int = 2,
+    num_search: int = 200,
+    num_top: int = 10,
+    smoke_test: bool = False,
+    resume: bool = True,
+    train_fold_fn: Callable | None = None,
+    until: int = 2,
+    seed: int = 0,
+) -> SearchResult:
+    """Run phases 1 and 2; returns the final policy set plus accounting.
+
+    `train_fold_fn(conf, fold, save_path)` overrides phase-1 training
+    (the launcher passes a multi-host scatter; default trains in-process
+    sequentially, the single-host analog of the reference's Ray scatter,
+    ``search.py:170-206``).
+    """
+    if smoke_test:  # reference --smoke-test (search.py:153, 235)
+        num_search = 4
+
+    os.makedirs(save_dir, exist_ok=True)
+    mesh = make_mesh()
+    watch = {"start": time.time()}
+    result = SearchResult()
+
+    # ---------------- phase 1: pretrain without augmentation ----------
+    t0 = time.time()
+    no_aug_conf = conf.replace(aug="default")
+    fold_paths = []
+    for fold in range(cv_num):
+        path = _fold_ckpt_path(save_dir, conf, fold, cv_ratio)
+        fold_paths.append(path)
+        meta = read_metadata(path)
+        if resume and meta and meta.get("epoch", 0) >= int(conf["epoch"]):
+            logger.info("phase1: fold %d already trained (epoch %d)", fold, meta["epoch"])
+            continue
+        logger.info("phase1: training fold %d -> %s", fold, path)
+        if train_fold_fn is not None:
+            train_fold_fn(no_aug_conf, fold, path)
+        else:
+            train_and_eval(
+                no_aug_conf, dataroot,
+                test_ratio=cv_ratio, cv_fold=fold,
+                save_path=path, metric="last", seed=seed,
+            )
+    result["tpu_secs_phase1"] = (time.time() - t0) * mesh.size
+    if until < 2:
+        result["final_policy_set"] = []
+        result["elapsed_total"] = time.time() - watch["start"]
+        return result
+
+    # ---------------- phase 2: TPE search per fold --------------------
+    t0 = time.time()
+    dataset_name = conf["dataset"]
+    num_classes = num_class(dataset_name)
+    total_train, _test = load_dataset(dataset_name, dataroot)
+    model = get_model(dict(conf["model"], dataset=dataset_name), num_classes)
+    cutout_length = int(conf.get("cutout", 0) or 0)
+
+    # the TTA loaders use the TRAIN transform stack (the reference's
+    # validloader shares the train dataset's transforms, data.py:88-112)
+    from fast_autoaugment_tpu.data.pipeline import BatchIterator
+    from fast_autoaugment_tpu.models import input_image_size
+
+    image = input_image_size(dataset_name, conf["model"]["type"])
+    if dataset_name.endswith("imagenet"):
+        from fast_autoaugment_tpu.ops.preprocess_imagenet import (
+            imagenet_train_batch,
+            random_crop_box,
+        )
+
+        tta_augment_fn = lambda images, pol, key: imagenet_train_batch(  # noqa: E731
+            images, key, pol, cutout_length=cutout_length
+        )
+        box_fn = lambda rng, w, h: random_crop_box(rng, w, h, image)  # noqa: E731
+    else:
+        tta_augment_fn = None
+        box_fn = None
+    tta_step = make_tta_step(
+        model, num_policy=num_policy, cutout_length=cutout_length,
+        augment_fn=tta_augment_fn,
+    )
+
+    # checkpoint template, built once (models are input-size-polymorphic
+    # after init, but use the real resolution for clarity)
+    from fast_autoaugment_tpu.ops.optim import build_optimizer
+    from fast_autoaugment_tpu.train.steps import create_train_state
+
+    sample = jnp.zeros((2, image, image, 3), jnp.float32)
+    optimizer = build_optimizer(dict(conf["optimizer"]), lambda s: 0.0)
+    template = create_train_state(
+        model, optimizer, jax.random.PRNGKey(0), sample,
+        use_ema=bool(conf.get("optimizer", {}).get("ema", 0)),
+    )
+
+    space = make_search_space(num_policy, num_op)
+    final_policy_set = []
+    trials_path = os.path.join(save_dir, "search_trials.json")
+    trials_log: dict = {}
+    if resume and os.path.exists(trials_path):
+        with open(trials_path) as fh:
+            trials_log = json.load(fh)
+
+    for fold in range(cv_num):
+        path = fold_paths[fold]
+        state = load_checkpoint(path, template)
+        params, batch_stats = state.params, state.batch_stats
+
+        _train_idx, valid_idx = cv_split(total_train.labels, cv_ratio, fold)
+        batch = int(conf["batch"]) * mesh.size
+        fold_it = BatchIterator(
+            total_train, valid_idx,
+            eval_box_fn=box_fn, train_box_fn=box_fn, imgsize=image,
+        )
+
+        tpe = TPE(space, seed=seed * 1000 + fold)
+        key_fold = jax.random.PRNGKey(seed * 77 + fold)
+        fold_trials = trials_log.get(str(fold), [])
+        for sample_dict, reward in fold_trials:  # resume previous trials
+            tpe.tell(sample_dict, reward)
+
+        while len(tpe.observations) < num_search:
+            trial_idx = len(tpe.observations)
+            proposal = tpe.suggest()
+            policies = policy_decoder(proposal, num_policy, num_op)
+            policy_t = jnp.asarray(policy_to_tensor(policies))
+            metrics = eval_tta(
+                tta_step, params, batch_stats,
+                fold_it.eval_epoch(batch),
+                policy_t, mesh, jax.random.fold_in(key_fold, trial_idx),
+            )
+            tpe.tell(proposal, metrics["top1_valid"])
+            fold_trials.append((proposal, metrics["top1_valid"]))
+            if trial_idx % 10 == 0 or trial_idx == num_search - 1:
+                logger.info(
+                    "phase2 fold %d trial %d/%d: top1_valid=%.4f best=%.4f",
+                    fold, trial_idx, num_search, metrics["top1_valid"], tpe.best[1],
+                )
+                trials_log[str(fold)] = fold_trials
+                with open(trials_path, "w") as fh:
+                    json.dump(trials_log, fh)
+
+        trials_log[str(fold)] = fold_trials
+        with open(trials_path, "w") as fh:
+            json.dump(trials_log, fh)
+
+        # top-N trials of this fold -> decoded policies (search.py:253-259)
+        ranked = sorted(tpe.observations, key=lambda o: -o[1])[:num_top]
+        for proposal, _reward in ranked:
+            final_policy_set.extend(policy_decoder(proposal, num_policy, num_op))
+
+    final_policy_set = remove_duplicates(final_policy_set)
+    result["final_policy_set"] = final_policy_set
+    result["tpu_secs_phase2"] = (time.time() - t0) * mesh.size
+    result["num_sub_policies"] = len(final_policy_set)
+
+    with open(os.path.join(save_dir, "final_policy.json"), "w") as fh:
+        json.dump(final_policy_set, fh)
+    logger.info(
+        "search done: %d sub-policies; phase1 %.1f TPU-s, phase2 %.1f TPU-s",
+        len(final_policy_set), result["tpu_secs_phase1"], result["tpu_secs_phase2"],
+    )
+    result["elapsed_total"] = time.time() - watch["start"]
+    return result

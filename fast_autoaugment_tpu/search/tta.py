@@ -1,0 +1,108 @@
+"""Density-matching TTA evaluation — the search's inner loop.
+
+The reference's ``eval_tta`` (``search.py:70-134``) loads a fold
+checkpoint, builds ``num_policy`` independently-augmented copies of the
+held-out fold loader (all applying the SAME candidate policy set, each
+with fresh randomness), and per batch records:
+
+- ``minus_loss``: minus the MINIMUM loss over all (policy-draw, sample)
+  pairs of the batch — a batch-global scalar, not per-sample
+  (SURVEY.md errata 2), and
+- ``correct``: per-sample max of top-1 correctness across the draws,
+
+normalized by sample count at the end.
+
+Here that whole inner loop is ONE jitted step: the candidate policy is
+a TENSOR argument, the P augmentation draws are a vmap, and the P*B
+forward runs as a single batch on the mesh.  Because nothing about the
+policy is baked into the compilation, every TPE sample reuses the same
+executable — the property that makes search cheap on TPU (SURVEY.md
+hard-part 3; the reference pays a fresh loader build per trial
+instead, ``search.py:87-91``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_autoaugment_tpu.core.metrics import Accumulator
+from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
+from fast_autoaugment_tpu.parallel.mesh import shard_batch
+
+__all__ = ["make_tta_step", "eval_tta"]
+
+
+def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
+                  augment_fn: Callable | None = None):
+    """Build the jitted TTA evaluation step.
+
+    Returns ``fn(params, batch_stats, images_u8, labels, mask, policy,
+    key) -> {"minus_loss_sum", "correct_sum", "cnt"}`` where `policy`
+    is a [num_sub, num_op, 3] tensor applied `num_policy` times with
+    independent randomness.
+    """
+    if augment_fn is None:
+        def augment_fn(images, policy, key):
+            return cifar_train_batch(images, key, policy=policy,
+                                     cutout_length=cutout_length)
+
+    @jax.jit
+    def tta_step(params, batch_stats, images, labels, mask, policy, key):
+        keys = jax.random.split(key, num_policy)
+
+        def one_draw(k):
+            return augment_fn(images, policy, k)
+
+        augmented = jax.vmap(one_draw)(keys)  # [P, B, H, W, C]
+        p, b = augmented.shape[0], augmented.shape[1]
+        flat = augmented.reshape((p * b,) + augmented.shape[2:])
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, flat, train=False
+        )
+        logits = logits.reshape(p, b, -1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[None, :, None], axis=-1)[..., 0]  # [P, B]
+        correct = (jnp.argmax(logits, axis=-1) == labels[None, :])  # [P, B]
+
+        # batch-global min loss over every (draw, sample) pair, masked
+        nll_masked = jnp.where(mask[None, :] > 0, nll, jnp.inf)
+        minus_loss = -jnp.min(nll_masked)
+        # per-sample best across draws
+        correct_max = correct.any(axis=0) * (mask > 0)
+        return {
+            "minus_loss_sum": minus_loss,
+            "correct_sum": correct_max.sum().astype(jnp.float32),
+            "cnt": mask.sum().astype(jnp.float32),
+        }
+
+    return tta_step
+
+
+def eval_tta(tta_step, params, batch_stats, batches, policy, mesh, key) -> dict:
+    """Run the TTA step over a fold's batches; returns
+    {'minus_loss', 'top1_valid'} normalized by sample count
+    (reference ``search.py:117-133``)."""
+    acc = Accumulator()
+    for i, (images, labels) in enumerate(batches):
+        n = len(labels)
+        pad = (-n) % mesh.size
+        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        if pad:
+            images = np.concatenate([images, np.repeat(images[-1:], pad, axis=0)])
+            labels = np.concatenate([labels, np.repeat(labels[-1:], pad, axis=0)])
+        batch = shard_batch(mesh, {"x": images, "y": labels, "m": mask})
+        out = tta_step(
+            params, batch_stats, batch["x"], batch["y"], batch["m"], policy,
+            jax.random.fold_in(key, i),
+        )
+        acc.add_dict(out)
+    cnt = acc["cnt"]
+    return {
+        "minus_loss": acc["minus_loss_sum"] / cnt if cnt else 0.0,
+        "top1_valid": acc["correct_sum"] / cnt if cnt else 0.0,
+        "cnt": cnt,
+    }

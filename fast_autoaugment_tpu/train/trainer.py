@@ -51,16 +51,25 @@ __all__ = ["train_and_eval", "resolve_policy_tensor"]
 logger = get_logger("faa_tpu.train")
 
 
+# conf-name -> archive-name mapping (reference data.py:91-106)
+AUG_ALIASES = {
+    "fa_reduced_imagenet": "fa_resnet50_rimagenet",
+    "arsaug": "arsaug_policy",
+    "autoaug_cifar10": "autoaug_paper_cifar10",
+    "autoaug_extend": "autoaug_policy",
+}
+
+
 def resolve_policy_tensor(aug: Any):
     """conf['aug'] -> policy tensor or None ('default').
 
-    Accepts an archive name, an explicit policy list (the search's
-    decoded candidates), or 'default'/None.
+    Accepts an archive name (or its conf alias), an explicit policy
+    list (the search's decoded candidates), or 'default'/None.
     """
     if aug in (None, "default"):
         return None
     if isinstance(aug, str):
-        return jnp.asarray(policy_to_tensor(load_policy(aug)))
+        return jnp.asarray(policy_to_tensor(load_policy(AUG_ALIASES.get(aug, aug))))
     # explicit list of sub-policies
     return jnp.asarray(policy_to_tensor([list(map(tuple, sub)) for sub in aug]))
 
@@ -111,12 +120,37 @@ def train_and_eval(
         train_idx, valid_idx = cv_split(total_train.labels, test_ratio, cv_fold)
     else:
         train_idx, valid_idx = np.arange(len(total_train)), np.array([], np.int64)
-    train_it = BatchIterator(total_train, train_idx)
-    valid_it = BatchIterator(total_train, valid_idx)
-    test_it = BatchIterator(testset)
+
+    is_imagenet = dataset_name.endswith("imagenet")
+    from fast_autoaugment_tpu.models import input_image_size
+
+    image = input_image_size(dataset_name, conf["model"]["type"])
+    if is_imagenet:
+        from fast_autoaugment_tpu.ops.preprocess_imagenet import (
+            center_crop_box,
+            imagenet_eval_batch,
+            imagenet_train_batch,
+            random_crop_box,
+        )
+
+        train_box = lambda rng, w, h: random_crop_box(rng, w, h, image)  # noqa: E731
+        eval_box = lambda rng, w, h: center_crop_box(w, h, image)  # noqa: E731
+    else:
+        train_box = eval_box = None
+    it_kw = dict(train_box_fn=train_box, eval_box_fn=eval_box, imgsize=image)
+    train_it = BatchIterator(total_train, train_idx, **it_kw)
+    valid_it = BatchIterator(total_train, valid_idx, **it_kw)
+    test_it = BatchIterator(testset, **it_kw)
 
     batch_per_device = int(conf["batch"])
     global_batch = batch_per_device * mesh.size
+    if not only_eval and len(train_idx) < global_batch:
+        raise ValueError(
+            f"training set has {len(train_idx)} examples < global batch "
+            f"{global_batch} ({batch_per_device}/device x {mesh.size} devices); "
+            "every epoch would be empty (train batches drop the last partial "
+            "batch, reference data.py:215)"
+        )
     steps_per_epoch = max(1, len(train_idx) // global_batch)
     epochs = int(conf["epoch"])
 
@@ -125,9 +159,6 @@ def train_and_eval(
     optimizer_conf = conf["optimizer"]
     ema_mu = float(optimizer_conf.get("ema", 0.0) or 0.0)
 
-    from fast_autoaugment_tpu.models import input_image_size
-
-    image = input_image_size(dataset_name, conf["model"]["type"])
     sample = jnp.zeros((2, image, image, 3), jnp.float32)
     rng = jax.random.PRNGKey(seed)
 
@@ -135,6 +166,16 @@ def train_and_eval(
     state = create_train_state(model, optimizer, rng, sample, use_ema=ema_mu > 0.0)
 
     policy = resolve_policy_tensor(conf.get("aug", "default"))
+    use_policy = policy is not None
+    if is_imagenet:
+        cutout_len = int(conf.get("cutout", 0) or 0)
+        augment_fn = lambda images, pol, key: imagenet_train_batch(  # noqa: E731
+            images, key, pol if use_policy else None, cutout_length=cutout_len
+        )
+        eval_preprocess = imagenet_eval_batch
+    else:
+        augment_fn = None
+        eval_preprocess = None
     train_step = make_train_step(
         model,
         optimizer,
@@ -143,9 +184,12 @@ def train_and_eval(
         lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
         ema_mu=ema_mu,
         cutout_length=int(conf.get("cutout", 0) or 0),
-        use_policy=policy is not None,
+        use_policy=use_policy,
+        augment_fn=augment_fn,
     )
-    eval_step = make_eval_step(model, num_classes=num_classes)
+    eval_step = make_eval_step(model, num_classes=num_classes,
+                               lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
+                               preprocess_fn=eval_preprocess)
 
     writers = make_writers(
         os.path.dirname(save_path) if save_path else None,

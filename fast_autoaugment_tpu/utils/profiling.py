@@ -1,0 +1,116 @@
+"""Profiling, step timing, and TPU-hours accounting.
+
+The reference's observability is wall-clock only: total run time
+(``train.py:345-354``), per-phase stopwatches in search
+(``search.py:139-140,172,206,263``) and the per-trial "GPU-seconds"
+``wall x device_count`` that feed its headline GPU-hours numbers
+(``search.py:132-133,251-252``).  TPU-native equivalents:
+
+- :func:`trace` — ``jax.profiler`` trace capture around any region
+  (view in TensorBoard/XProf); the reference has no profiler at all;
+- :class:`StepTimer` — per-step wall timing with warmup skip, giving
+  steady-state images/sec;
+- :class:`PhaseStopwatch` — named phase accounting in device-seconds
+  (``wall x device_count``), the reference's GPU-hours ledger
+  generalized.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["trace", "StepTimer", "PhaseStopwatch"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None):
+    """Capture a jax.profiler trace into `logdir` (no-op if None)."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Steady-state step timing: skips `warmup` steps (compilation),
+    then tracks mean step time and throughput."""
+
+    def __init__(self, warmup: int = 3):
+        self.warmup = warmup
+        self.count = 0
+        self.total = 0.0
+        self._last = None
+
+    def start(self):
+        self._last = time.perf_counter()
+
+    def stop(self, items: int = 0) -> float:
+        dt = time.perf_counter() - self._last
+        self.count += 1
+        if self.count > self.warmup:
+            self.total += dt
+            self._items = getattr(self, "_items", 0) + items
+        return dt
+
+    @property
+    def steps_timed(self) -> int:
+        return max(0, self.count - self.warmup)
+
+    @property
+    def mean_step_seconds(self) -> float:
+        return self.total / self.steps_timed if self.steps_timed else 0.0
+
+    @property
+    def items_per_second(self) -> float:
+        return getattr(self, "_items", 0) / self.total if self.total else 0.0
+
+
+class PhaseStopwatch:
+    """Named-phase wall + device-seconds ledger (the reference's
+    pystopwatch2 + GPU-hours accounting)."""
+
+    def __init__(self, device_count: int | None = None):
+        self.device_count = device_count or jax.device_count()
+        self.phases: dict[str, float] = {}
+        self._open: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        self.start(name)
+        try:
+            yield
+        finally:
+            self.stop(name)
+
+    def start(self, name: str):
+        self._open[name] = time.time()
+
+    def stop(self, name: str):
+        if name in self._open:
+            self.phases[name] = self.phases.get(name, 0.0) + (time.time() - self._open.pop(name))
+
+    def wall_seconds(self, name: str) -> float:
+        return self.phases.get(name, 0.0)
+
+    def device_seconds(self, name: str) -> float:
+        return self.wall_seconds(name) * self.device_count
+
+    def device_hours(self, name: str) -> float:
+        return self.device_seconds(name) / 3600.0
+
+    def summary(self) -> dict:
+        return {
+            name: {
+                "wall_sec": round(w, 2),
+                "device_sec": round(w * self.device_count, 2),
+                "device_hours": round(w * self.device_count / 3600.0, 4),
+            }
+            for name, w in self.phases.items()
+        }

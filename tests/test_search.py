@@ -1,0 +1,147 @@
+"""Search engine tests: TPE convergence on a toy problem, TTA-step
+reduction semantics, and the end-to-end smoke search (the analog of the
+reference's --smoke-test, search.py:153)."""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fast_autoaugment_tpu.search.tpe import TPE, choice, uniform
+
+
+def test_tpe_beats_random_on_quadratic():
+    space = [uniform("x", 0, 1), uniform("y", 0, 1), choice("c", 4)]
+
+    def objective(s):
+        return -((s["x"] - 0.7) ** 2) - (s["y"] - 0.2) ** 2 + (0.5 if s["c"] == 2 else 0.0)
+
+    tpe = TPE(space, seed=0)
+    for _ in range(120):
+        s = tpe.suggest()
+        tpe.tell(s, objective(s))
+
+    rng = np.random.default_rng(0)
+    random_best = max(
+        objective({"x": rng.uniform(), "y": rng.uniform(), "c": int(rng.integers(4))})
+        for _ in range(120)
+    )
+    best_x, best_r = tpe.best
+    assert best_r >= random_best - 0.02
+    assert best_x["c"] == 2
+    assert abs(best_x["x"] - 0.7) < 0.25
+
+
+def test_tpe_deterministic():
+    space = [uniform("x"), choice("c", 3)]
+    a, b = TPE(space, seed=5), TPE(space, seed=5)
+    for _ in range(30):
+        sa, sb = a.suggest(), b.suggest()
+        assert sa == sb
+        a.tell(sa, sa["x"])
+        b.tell(sb, sb["x"])
+
+
+def test_tta_step_reductions():
+    """Identity-policy TTA on a fixed linear model: minus_loss must be the
+    batch-global min; correct must be the per-sample any() across draws."""
+    from flax import linen as nn
+
+    from fast_autoaugment_tpu.parallel.mesh import make_mesh
+    from fast_autoaugment_tpu.search.tta import eval_tta, make_tta_step
+
+    class Probe(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            # logits depend deterministically on mean pixel: samples with
+            # high mean get class 1
+            m = x.mean(axis=(1, 2, 3), keepdims=False)
+            return jnp.stack([jnp.zeros_like(m), m * 10.0], axis=-1)
+
+    model = Probe()
+    tta = make_tta_step(model, num_policy=3, cutout_length=0,
+                        augment_fn=lambda im, pol, k: im / 255.0 - 0.5)
+    mesh = make_mesh(jax.devices()[:1])
+
+    images = np.zeros((4, 8, 8, 3), np.uint8)
+    images[2:] = 255  # samples 2,3 -> mean 0.5 -> logit 5 -> class 1
+    labels = np.array([1, 1, 1, 1], np.int32)
+    out = eval_tta(tta, {}, {}, [(images, labels)],
+                   jnp.zeros((1, 1, 3)), mesh, jax.random.PRNGKey(0))
+    # samples 0,1 predict class 0 (wrong), 2,3 predict 1 (right)
+    assert out["top1_valid"] == pytest.approx(0.5)
+    # min nll over all = nll of a correct confident sample
+    assert out["minus_loss"] < 0.0
+    assert out["cnt"] == 4
+
+
+@pytest.mark.slow
+def test_smoke_search_on_imagenet_family(tmp_path):
+    """Regression: phase 2 must decode lazy variable-size images through
+    the boxed crop path and use the ImageNet TTA stack (was: np.stack
+    shape crash + CIFAR stack silently applied)."""
+    from tests.test_imagenet_pipeline import _write_fake_imagenet
+
+    from fast_autoaugment_tpu.core.config import Config
+    from fast_autoaugment_tpu.search.driver import search_policies
+
+    _write_fake_imagenet(str(tmp_path))
+    conf = Config({
+        "model": {"type": "wresnet10_1"},
+        "dataset": "imagenet",
+        "aug": "default",
+        "cutout": 0,
+        "batch": 1,
+        "epoch": 1,
+        "lr": 0.01,
+        "lr_schedule": {"type": "cosine"},
+        "optimizer": {"type": "sgd", "decay": 1e-4, "clip": 5.0,
+                      "momentum": 0.9, "nesterov": True},
+    })
+    result = search_policies(
+        conf, dataroot=str(tmp_path), save_dir=str(tmp_path / "s"),
+        cv_num=1, cv_ratio=0.4, num_policy=2, num_op=2,
+        num_search=2, num_top=1, smoke_test=False,
+    )
+    assert 1 <= len(result["final_policy_set"]) <= 2
+
+
+@pytest.mark.slow
+def test_smoke_search_end_to_end():
+    from fast_autoaugment_tpu.core.config import Config
+    from fast_autoaugment_tpu.search.driver import search_policies
+
+    conf = Config({
+        "model": {"type": "wresnet10_1"},
+        "dataset": "synthetic",
+        "aug": "default",
+        "cutout": 8,
+        "batch": 8,
+        "epoch": 1,
+        "lr": 0.05,
+        "lr_schedule": {"type": "cosine"},
+        "optimizer": {"type": "sgd", "decay": 1e-4, "clip": 5.0,
+                      "momentum": 0.9, "nesterov": True},
+    })
+    with tempfile.TemporaryDirectory() as tmp:
+        result = search_policies(
+            conf, dataroot=tmp, save_dir=os.path.join(tmp, "search"),
+            cv_num=2, cv_ratio=0.4, num_policy=2, num_op=2,
+            num_search=4, num_top=2, smoke_test=True,
+        )
+        pols = result["final_policy_set"]
+        assert 1 <= len(pols) <= 2 * 2 * 2
+        for sub in pols:
+            assert len(sub) == 2
+            for op, prob, level in sub:
+                assert 0 <= prob <= 1 and 0 <= level <= 1
+        # artifacts written
+        assert os.path.exists(os.path.join(tmp, "search", "final_policy.json"))
+        trials = json.load(open(os.path.join(tmp, "search", "search_trials.json")))
+        assert set(trials) == {"0", "1"}
+        assert result["tpu_secs_phase2"] > 0
