@@ -46,7 +46,8 @@ def main():
         "lr": 0.1, "epoch": 200,
         "lr_schedule": {"type": "cosine", "warmup": {"multiplier": 2, "epoch": 5}},
     }
-    model = get_model({"type": "wresnet40_2"}, 10)
+    # bf16 activations (f32 params/BN) — the TPU-first precision choice
+    model = get_model({"type": "wresnet40_2", "precision": "bf16"}, 10)
     optimizer = build_optimizer(
         {"type": "sgd", "decay": 2e-4, "clip": 5.0, "momentum": 0.9, "nesterov": True},
         build_schedule(conf, steps_per_epoch=50000 // global_batch,

@@ -225,6 +225,14 @@ def load_dataset(dataset: str, dataroot: str):
             lazy=True,
         )
         return train, test
+    if dataset == "cifar10.1":
+        # CIFAR-10.1 v6 (Recht et al.) distribution-shift TEST set paired
+        # with the standard CIFAR-10 train set; numpy files from the
+        # released dataset (cifar10.1_v6_{data,labels}.npy)
+        train, _ = _load_cifar(dataroot, "cifar10")
+        data = np.load(os.path.join(dataroot, "cifar10.1_v6_data.npy"))
+        labels = np.load(os.path.join(dataroot, "cifar10.1_v6_labels.npy"))
+        return train, ArrayDataset(data.astype(np.uint8), labels.astype(np.int32), 10)
     if dataset.startswith("synthetic"):
         # synthetic / synthetic_cifar100-style names for tests and benches
         num_classes = 100 if dataset.endswith("100") else 10

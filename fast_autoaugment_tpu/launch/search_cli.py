@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="phase-3 retrains per mode (reference search.py:270)")
     p.add_argument("--until", type=int, default=3,
                    help="run phases up to this number (1, 2 or 3)")
+    p.add_argument("--folds", default=None,
+                   help="comma-separated fold subset for multi-host scatter")
     p.add_argument("--smoke-test", action="store_true")
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
@@ -65,6 +67,7 @@ def main(argv=None):
         smoke_test=args.smoke_test,
         resume=not args.no_resume,
         until=args.until,
+        folds=[int(f) for f in args.folds.split(",")] if args.folds else None,
         seed=args.seed,
     )
     final_policy_set = result["final_policy_set"]

@@ -83,6 +83,10 @@ class BatchNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
+        # statistics always in float32 (mixed-precision safety: bf16
+        # variance accumulation is too coarse); output follows the
+        # activation dtype
+        in_dtype = x.dtype
         norm = nn.BatchNorm(
             use_running_average=not train,
             momentum=1.0 - self.momentum,
@@ -90,9 +94,9 @@ class BatchNorm(nn.Module):
             use_scale=self.use_scale,
             use_bias=self.use_bias,
             axis_name=self.axis_name,
-            dtype=x.dtype,
+            dtype=jnp.float32,
         )
-        return norm(x)
+        return norm(x.astype(jnp.float32)).astype(in_dtype)
 
 
 def global_avg_pool(x: jax.Array) -> jax.Array:

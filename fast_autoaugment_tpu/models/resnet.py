@@ -10,6 +10,9 @@ Capability match for the reference ``networks/resnet.py:13-180``
 
 from __future__ import annotations
 
+from typing import Any
+
+import jax.numpy as jnp
 from flax import linen as nn
 
 from fast_autoaugment_tpu.models.layers import BatchNorm, global_avg_pool, he_normal_fanout
@@ -26,7 +29,7 @@ IMAGENET_LAYERS = {
 }
 
 
-def _conv(features, kernel, stride, name=None):
+def _conv(features, kernel, stride, dtype=None, name=None):
     return nn.Conv(
         features,
         (kernel, kernel),
@@ -34,6 +37,7 @@ def _conv(features, kernel, stride, name=None):
         padding=[(kernel // 2, kernel // 2)] * 2,
         use_bias=False,
         kernel_init=he_normal_fanout,
+        dtype=dtype,
         name=name,
     )
 
@@ -41,17 +45,19 @@ def _conv(features, kernel, stride, name=None):
 class BasicBlock(nn.Module):
     features: int
     stride: int = 1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         residual = x
-        out = _conv(self.features, 3, self.stride, name="conv1")(x)
+        out = _conv(self.features, 3, self.stride, dtype=self.dtype, name="conv1")(x)
         out = BatchNorm(name="bn1")(out, train)
         out = nn.relu(out)
-        out = _conv(self.features, 3, 1, name="conv2")(out)
+        out = _conv(self.features, 3, 1, dtype=self.dtype, name="conv2")(out)
         out = BatchNorm(name="bn2")(out, train)
         if self.stride != 1 or x.shape[-1] != self.features:
-            residual = _conv(self.features, 1, self.stride, name="downsample_conv")(x)
+            residual = _conv(self.features, 1, self.stride, dtype=self.dtype,
+                             name="downsample_conv")(x)
             residual = BatchNorm(name="downsample_bn")(residual, train)
         return nn.relu(out + residual)
 
@@ -60,19 +66,21 @@ class Bottleneck(nn.Module):
     features: int  # bottleneck width; output is 4x
     stride: int = 1
     expansion: int = 4
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         out_features = self.features * self.expansion
         residual = x
-        out = _conv(self.features, 1, 1, name="conv1")(x)
+        out = _conv(self.features, 1, 1, dtype=self.dtype, name="conv1")(x)
         out = nn.relu(BatchNorm(name="bn1")(out, train))
-        out = _conv(self.features, 3, self.stride, name="conv2")(out)
+        out = _conv(self.features, 3, self.stride, dtype=self.dtype, name="conv2")(out)
         out = nn.relu(BatchNorm(name="bn2")(out, train))
-        out = _conv(out_features, 1, 1, name="conv3")(out)
+        out = _conv(out_features, 1, 1, dtype=self.dtype, name="conv3")(out)
         out = BatchNorm(name="bn3")(out, train)
         if self.stride != 1 or x.shape[-1] != out_features:
-            residual = _conv(out_features, 1, self.stride, name="downsample_conv")(x)
+            residual = _conv(out_features, 1, self.stride, dtype=self.dtype,
+                             name="downsample_conv")(x)
             residual = BatchNorm(name="downsample_bn")(residual, train)
         return nn.relu(out + residual)
 
@@ -84,9 +92,11 @@ class ResNet(nn.Module):
     depth: int
     num_classes: int
     bottleneck: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
         if self.dataset.startswith("cifar") or self.dataset in ("svhn",):
             if self.bottleneck:
                 n = (self.depth - 2) // 9
@@ -94,27 +104,30 @@ class ResNet(nn.Module):
             else:
                 n = (self.depth - 2) // 6
                 block, widths = BasicBlock, (16, 32, 64)
-            out = _conv(16, 3, 1, name="conv1")(x)
+            out = _conv(16, 3, 1, dtype=self.dtype, name="conv1")(x)
             out = nn.relu(BatchNorm(name="bn1")(out, train))
             for stage, width in enumerate(widths):
                 for i in range(n):
                     stride = 2 if (stage > 0 and i == 0) else 1
-                    out = block(width, stride, name=f"layer{stage + 1}_{i}")(out, train)
+                    out = block(width, stride, dtype=self.dtype,
+                                name=f"layer{stage + 1}_{i}")(out, train)
         elif self.dataset == "imagenet":
             kind, counts = IMAGENET_LAYERS[self.depth]
             block = BasicBlock if kind == "basic" else Bottleneck
             out = nn.Conv(
                 64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                use_bias=False, kernel_init=he_normal_fanout, name="conv1",
+                use_bias=False, kernel_init=he_normal_fanout, dtype=self.dtype,
+                name="conv1",
             )(x)
             out = nn.relu(BatchNorm(name="bn1")(out, train))
             out = nn.max_pool(out, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
             for stage, (width, count) in enumerate(zip((64, 128, 256, 512), counts)):
                 for i in range(count):
                     stride = 2 if (stage > 0 and i == 0) else 1
-                    out = block(width, stride, name=f"layer{stage + 1}_{i}")(out, train)
+                    out = block(width, stride, dtype=self.dtype,
+                                name=f"layer{stage + 1}_{i}")(out, train)
         else:
             raise ValueError(f"unknown dataset {self.dataset!r}")
 
-        out = global_avg_pool(out)
+        out = global_avg_pool(out).astype(jnp.float32)
         return nn.Dense(self.num_classes, name="fc")(out)

@@ -10,6 +10,8 @@ reference's custom init is commented out, ``wideresnet.py:66``).
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -25,7 +27,8 @@ __all__ = ["WideResNet"]
 _BN_MOMENTUM = 0.9  # torch convention, reference wideresnet.py:24
 
 
-def _conv(features: int, kernel: int, stride: int, in_features: int, name: str | None = None):
+def _conv(features: int, kernel: int, stride: int, in_features: int,
+          dtype=None, name: str | None = None):
     return nn.Conv(
         features,
         (kernel, kernel),
@@ -34,6 +37,7 @@ def _conv(features: int, kernel: int, stride: int, in_features: int, name: str |
         use_bias=True,
         kernel_init=torch_default_kernel(),
         bias_init=torch_default_bias_for(in_features * kernel * kernel),
+        dtype=dtype,
         name=name,
     )
 
@@ -44,18 +48,21 @@ class WideBasic(nn.Module):
     features: int
     stride: int
     dropout_rate: float
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool, dropout_rng=None):
         in_features = x.shape[-1]
         out = nn.relu(BatchNorm(momentum=_BN_MOMENTUM, name="bn1")(x, train))
-        out = _conv(self.features, 3, 1, in_features, name="conv1")(out)
+        out = _conv(self.features, 3, 1, in_features, dtype=self.dtype, name="conv1")(out)
         if self.dropout_rate > 0.0:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         out = nn.relu(BatchNorm(momentum=_BN_MOMENTUM, name="bn2")(out, train))
-        out = _conv(self.features, 3, self.stride, self.features, name="conv2")(out)
+        out = _conv(self.features, 3, self.stride, self.features, dtype=self.dtype,
+                    name="conv2")(out)
         if self.stride != 1 or in_features != self.features:
-            shortcut = _conv(self.features, 1, self.stride, in_features, name="shortcut")(x)
+            shortcut = _conv(self.features, 1, self.stride, in_features,
+                             dtype=self.dtype, name="shortcut")(x)
         else:
             shortcut = x
         return out + shortcut
@@ -68,15 +75,17 @@ class WideResNet(nn.Module):
     widen_factor: int
     num_classes: int
     dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
         assert (self.depth - 4) % 6 == 0, "WideResNet depth must be 6n+4"
         n = (self.depth - 4) // 6
         k = self.widen_factor
         stages = (16, 16 * k, 32 * k, 64 * k)
 
-        out = _conv(stages[0], 3, 1, x.shape[-1], name="conv1")(x)
+        out = _conv(stages[0], 3, 1, x.shape[-1], dtype=self.dtype, name="conv1")(x)
         for stage, (features, stride) in enumerate(
             zip(stages[1:], (1, 2, 2)), start=1
         ):
@@ -85,6 +94,7 @@ class WideResNet(nn.Module):
                     features,
                     stride if i == 0 else 1,
                     self.dropout_rate,
+                    dtype=self.dtype,
                     name=f"layer{stage}_{i}",
                 )(out, train)
         out = nn.relu(BatchNorm(momentum=_BN_MOMENTUM, name="bn1")(out, train))
@@ -94,5 +104,5 @@ class WideResNet(nn.Module):
             kernel_init=torch_default_kernel(),
             bias_init=torch_default_bias_for(stages[3]),
             name="linear",
-        )(out)
+        )(out.astype(jnp.float32))
         return out

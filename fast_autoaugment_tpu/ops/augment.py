@@ -147,7 +147,14 @@ def _warp_affine_nearest(img: jax.Array, mat: jax.Array) -> jax.Array:
 
 
 def _histogram256(channel_int: jax.Array) -> jax.Array:
-    return jnp.zeros((256,), jnp.int32).at[channel_int.reshape(-1)].add(1)
+    """256-bin histogram as a one-hot reduction.
+
+    Scatter-adds serialize on TPU; a [N, 256] one-hot contraction rides
+    the MXU/VPU instead and vmaps cleanly over the batch.
+    """
+    flat = channel_int.reshape(-1)
+    onehot = jax.nn.one_hot(flat, 256, dtype=jnp.int32)
+    return onehot.sum(axis=0)
 
 
 # ---------------------------------------------------------------------------

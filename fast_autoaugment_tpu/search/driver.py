@@ -86,6 +86,7 @@ def search_policies(
     resume: bool = True,
     train_fold_fn: Callable | None = None,
     until: int = 2,
+    folds: list[int] | None = None,
     seed: int = 0,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
@@ -94,6 +95,12 @@ def search_policies(
     (the launcher passes a multi-host scatter; default trains in-process
     sequentially, the single-host analog of the reference's Ray scatter,
     ``search.py:170-206``).
+
+    `folds` restricts BOTH phases to a subset of fold indices — the
+    scatter unit for running the search across machines (host k runs
+    ``--folds k``, then one host merges the per-fold trial JSONs by
+    rerunning with all folds, which resumes instantly from the merged
+    trial state).
     """
     if smoke_test:  # reference --smoke-test (search.py:153, 235)
         num_search = 4
@@ -102,6 +109,19 @@ def search_policies(
     mesh = make_mesh()
     watch = {"start": time.time()}
     result = SearchResult()
+    fold_list = list(folds) if folds is not None else list(range(cv_num))
+    bad = [f for f in fold_list if not 0 <= f < cv_num]
+    if bad:
+        raise ValueError(f"fold indices {bad} out of range [0, {cv_num})")
+
+    trials_path = os.path.join(save_dir, "search_trials.json")
+    trials_log: dict = {}
+    if resume and os.path.exists(trials_path):
+        with open(trials_path) as fh:
+            trials_log = json.load(fh)
+
+    def _fold_searched(fold: int) -> bool:
+        return len(trials_log.get(str(fold), [])) >= num_search
 
     # ---------------- phase 1: pretrain without augmentation ----------
     t0 = time.time()
@@ -110,6 +130,12 @@ def search_policies(
     for fold in range(cv_num):
         path = _fold_ckpt_path(save_dir, conf, fold, cv_ratio)
         fold_paths.append(path)
+        if fold not in fold_list:
+            continue
+        if _fold_searched(fold):
+            # merged trial state from another host: nothing left to train
+            logger.info("phase1: fold %d already searched (merged trials)", fold)
+            continue
         meta = read_metadata(path)
         if resume and meta and meta.get("epoch", 0) >= int(conf["epoch"]):
             logger.info("phase1: fold %d already trained (epoch %d)", fold, meta["epoch"])
@@ -134,7 +160,9 @@ def search_policies(
     dataset_name = conf["dataset"]
     num_classes = num_class(dataset_name)
     total_train, _test = load_dataset(dataset_name, dataroot)
-    model = get_model(dict(conf["model"], dataset=dataset_name), num_classes)
+    model_conf = dict(conf["model"], dataset=dataset_name)
+    model_conf.setdefault("precision", conf.get("precision", "f32"))
+    model = get_model(model_conf, num_classes)
     cutout_length = int(conf.get("cutout", 0) or 0)
 
     # the TTA loaders use the TRAIN transform stack (the reference's
@@ -175,13 +203,11 @@ def search_policies(
 
     space = make_search_space(num_policy, num_op)
     final_policy_set = []
-    trials_path = os.path.join(save_dir, "search_trials.json")
-    trials_log: dict = {}
-    if resume and os.path.exists(trials_path):
-        with open(trials_path) as fh:
-            trials_log = json.load(fh)
 
-    for fold in range(cv_num):
+    for fold in fold_list:
+        if _fold_searched(fold):
+            logger.info("phase2: fold %d trials already complete", fold)
+            continue
         path = fold_paths[fold]
         state = load_checkpoint(path, template)
         params, batch_stats = state.params, state.batch_stats
@@ -224,8 +250,11 @@ def search_policies(
         with open(trials_path, "w") as fh:
             json.dump(trials_log, fh)
 
-        # top-N trials of this fold -> decoded policies (search.py:253-259)
-        ranked = sorted(tpe.observations, key=lambda o: -o[1])[:num_top]
+    # top-N per fold from the trial log (covers folds run here, folds
+    # merged from other hosts, and folds resumed from disk alike,
+    # search.py:253-259)
+    for fold_key in sorted(trials_log, key=int):
+        ranked = sorted(trials_log[fold_key], key=lambda o: -o[1])[:num_top]
         for proposal, _reward in ranked:
             final_policy_set.extend(policy_decoder(proposal, num_policy, num_op))
 

@@ -55,7 +55,14 @@ def create_train_state(model, optimizer, rng, sample_input, use_ema: bool) -> Tr
     )
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
-    ema = {"params": params, "batch_stats": batch_stats} if use_ema else None
+    # the EMA shadow must be a DISTINCT set of buffers: the train step
+    # donates the whole state, and donating two references to one buffer
+    # is an error
+    ema = (
+        jax.tree.map(jnp.copy, {"params": params, "batch_stats": batch_stats})
+        if use_ema
+        else None
+    )
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -108,7 +115,9 @@ def make_train_step(
             loss = smooth_cross_entropy(logits, labels, lb_smooth)
         return loss, (logits, mutated["batch_stats"])
 
-    @jax.jit
+    # donate the state: params/opt-state/EMA buffers are overwritten in
+    # place, halving peak HBM for the update
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step_fn(state: TrainState, images, labels, policy, key):
         key_aug, key_model = jax.random.split(jax.random.fold_in(key, state.step))
         images = augment_fn(images, policy, key_aug)

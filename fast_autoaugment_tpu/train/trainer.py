@@ -100,6 +100,7 @@ def train_and_eval(
     only_eval: bool = False,
     evaluation_interval: int = 5,
     mesh=None,
+    target_lb: int = -1,
     seed: int = 0,
 ) -> dict:
     """Train (or just evaluate) one model under `conf`.
@@ -118,6 +119,10 @@ def train_and_eval(
 
     if test_ratio > 0.0:
         train_idx, valid_idx = cv_split(total_train.labels, test_ratio, cv_fold)
+        if target_lb >= 0:
+            # single-class restriction (reference data.py:199-201)
+            train_idx = train_idx[total_train.labels[train_idx] == target_lb]
+            valid_idx = valid_idx[total_train.labels[valid_idx] == target_lb]
     else:
         train_idx, valid_idx = np.arange(len(total_train)), np.array([], np.int64)
 
@@ -154,7 +159,9 @@ def train_and_eval(
     steps_per_epoch = max(1, len(train_idx) // global_batch)
     epochs = int(conf["epoch"])
 
-    model = get_model(dict(conf["model"], dataset=dataset_name), num_classes)
+    model_conf = dict(conf["model"], dataset=dataset_name)
+    model_conf.setdefault("precision", conf.get("precision", "f32"))
+    model = get_model(model_conf, num_classes)
     lr_fn = build_schedule(conf, steps_per_epoch, world_lr_scale=float(mesh.size))
     optimizer_conf = conf["optimizer"]
     ema_mu = float(optimizer_conf.get("ema", 0.0) or 0.0)
@@ -228,7 +235,12 @@ def train_and_eval(
                     eval_step, state.ema["params"], state.ema["batch_stats"],
                     it.eval_epoch(global_batch), mesh,
                 )
+                # with EMA on, the REPORTED valid/test numbers are the
+                # EMA model's (reference train.py:277-280 overwrites
+                # rs['valid']/rs['test']); raw weights kept under _raw
+                out[split + "_raw"] = norm
                 out[split + "_ema"] = norm_ema
+                out[split] = norm_ema
         return out
 
     if only_eval:
@@ -257,6 +269,16 @@ def train_and_eval(
         train_metrics = acc.normalize()
         if np.isnan(train_metrics["loss"]):
             raise RuntimeError("loss is NaN — training diverged (reference train.py:259)")
+
+        # periodic EMA -> model weight restore (reference train.py:262-270)
+        ema_interval = int(optimizer_conf.get("ema_interval", -1) or -1)
+        if state.ema is not None and ema_interval > 0 and epoch % ema_interval == 0:
+            logger.info("ema synced into model at epoch %d", epoch)
+            # copy: params must not alias the EMA shadow (donated buffers)
+            state = state.replace(
+                params=jax.tree.map(jnp.copy, state.ema["params"]),
+                batch_stats=jax.tree.map(jnp.copy, state.ema["batch_stats"]),
+            )
         for k in ("loss", "top1", "top5"):
             writers[0].add_scalar(k, train_metrics[k], epoch)
         logger.info(
@@ -272,9 +294,14 @@ def train_and_eval(
             evals = evaluate("eval", epoch)
             for split, m in evals.items():
                 widx = 1 if split.startswith("valid") else 2
+                if split.endswith("_ema"):
+                    tag_suffix = "_ema"
+                elif split.endswith("_raw"):
+                    tag_suffix = "_raw"
+                else:
+                    tag_suffix = ""
                 for k in ("loss", "top1", "top5"):
-                    writers[widx].add_scalar(f"{k}{'_ema' if split.endswith('_ema') else ''}",
-                                             m.get(k, 0.0), epoch)
+                    writers[widx].add_scalar(f"{k}{tag_suffix}", m.get(k, 0.0), epoch)
                 for k, v in m.items():
                     result[f"{k}_{split}"] = v
                 logger.info("[%s %3d/%3d] %s", split, epoch, epochs,
