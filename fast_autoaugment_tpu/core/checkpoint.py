@@ -45,10 +45,37 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None):
     os.replace(tmp_meta, _meta_path(path))
 
 
-def load_checkpoint(path: str, target: Any) -> Any:
-    """Restore a pytree of the same structure as `target` from `path`."""
+def load_checkpoint(path: str, target: Any, lenient: bool = False) -> Any:
+    """Restore a pytree of the same structure as `target` from `path`.
+
+    `lenient` merges only the fields present in the file onto the
+    template (used for checkpoints imported from the reference's torch
+    format, which carry params/batch_stats/ema but no optimizer state —
+    the analog of the reference's raw-state-dict handling,
+    ``train.py:191-204``).
+    """
     with open(path, "rb") as fh:
-        return serialization.from_bytes(target, fh.read())
+        payload = fh.read()
+    if not lenient:
+        return serialization.from_bytes(target, payload)
+
+    raw = serialization.msgpack_restore(payload)
+    template = serialization.to_state_dict(target)
+
+    def merge(tmpl, new):
+        if tmpl is None:
+            # template structure governs: a field the live state doesn't
+            # carry (e.g. ema when conf ema=0) is dropped, not grafted
+            return None
+        if not isinstance(tmpl, dict) or not isinstance(new, dict):
+            return new if new is not None else tmpl
+        out = dict(tmpl)
+        for k, v in new.items():
+            if k in out:
+                out[k] = merge(out[k], v)
+        return out
+
+    return serialization.from_state_dict(target, merge(template, raw))
 
 
 def read_metadata(path: str) -> dict | None:

@@ -65,7 +65,14 @@ def get_model(conf: Any, num_classes: int) -> nn.Module:
     precision = str(conf.get("precision", "f32") or "f32").lower()
     import jax.numpy as jnp
 
-    dtype = jnp.bfloat16 if precision in ("bf16", "bfloat16") else jnp.float32
+    if precision in ("bf16", "bfloat16"):
+        dtype = jnp.bfloat16
+    elif precision in ("f32", "fp32", "float32"):
+        dtype = jnp.float32
+    else:
+        raise ValueError(
+            f"unknown precision {precision!r}; use 'f32' or 'bf16'"
+        )
 
     if name in ("resnet50", "resnet200"):
         return ResNet(dataset="imagenet", depth=int(name[len("resnet"):]),

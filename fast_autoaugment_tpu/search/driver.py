@@ -252,9 +252,19 @@ def search_policies(
 
     # top-N per fold from the trial log (covers folds run here, folds
     # merged from other hosts, and folds resumed from disk alike,
-    # search.py:253-259)
+    # search.py:253-259); only in-range folds with COMPLETE searches count
     for fold_key in sorted(trials_log, key=int):
-        ranked = sorted(trials_log[fold_key], key=lambda o: -o[1])[:num_top]
+        fold_trials = trials_log[fold_key]
+        if not 0 <= int(fold_key) < cv_num:
+            logger.warning("ignoring stale fold %s in trial log", fold_key)
+            continue
+        if len(fold_trials) < num_search:
+            logger.warning(
+                "fold %s has %d/%d trials — incomplete, excluded from the "
+                "final policy set", fold_key, len(fold_trials), num_search,
+            )
+            continue
+        ranked = sorted(fold_trials, key=lambda o: -o[1])[:num_top]
         for proposal, _reward in ranked:
             final_policy_set.extend(policy_decoder(proposal, num_policy, num_op))
 

@@ -207,8 +207,23 @@ def train_and_eval(
     epoch_start = 1
     if save_path and checkpoint_exists(save_path):
         meta = read_metadata(save_path) or {}
-        state = load_checkpoint(save_path, state)
+        # lenient when the file came from the torch importer (no opt_state)
+        lenient = bool(meta.get("imported_from"))
+        state = load_checkpoint(save_path, state, lenient=lenient)
         epoch_start = int(meta.get("epoch", 0)) + 1
+        if lenient:
+            fixes = {}
+            # the schedule is a pure fn of step: place it at the resume
+            # epoch, not back at warmup
+            fixes["step"] = jnp.int32((epoch_start - 1) * steps_per_epoch)
+            if state.ema is not None and not meta.get("has_ema"):
+                # no EMA in the imported file: seed the shadow from the
+                # imported weights, never from random init
+                fixes["ema"] = jax.tree.map(
+                    jnp.copy,
+                    {"params": state.params, "batch_stats": state.batch_stats},
+                )
+            state = state.replace(**fixes)
         logger.info("resumed %s at epoch %d", save_path, epoch_start - 1)
         if epoch_start > epochs:
             only_eval = True

@@ -48,6 +48,29 @@ def test_parse_overrides_yaml_coercion():
     assert out == {"a": 5, "b": True, "c": "hello", "d": [1, 2]}
 
 
+def test_lenient_checkpoint_merge_semantics(tmp_path):
+    import numpy as np
+
+    from fast_autoaugment_tpu.core.checkpoint import load_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "ck.msgpack")
+    # file has params + an ema the target doesn't want
+    save_checkpoint(path, {"params": {"w": np.ones(3)}, "ema": {"w": np.ones(3) * 7}},
+                    {"epoch": 1})
+
+    # 1) template WITHOUT ema: grafting must drop the file's ema
+    target = {"params": {"w": np.zeros(3)}, "ema": None, "opt": {"m": np.zeros(2)}}
+    out = load_checkpoint(path, target, lenient=True)
+    np.testing.assert_array_equal(out["params"]["w"], 1.0)
+    assert out["ema"] is None
+    np.testing.assert_array_equal(out["opt"]["m"], 0.0)  # kept from template
+
+    # 2) template WITH ema and file WITH ema: file wins
+    target2 = {"params": {"w": np.zeros(3)}, "ema": {"w": np.zeros(3)}, "opt": None}
+    out2 = load_checkpoint(path, target2, lenient=True)
+    np.testing.assert_array_equal(out2["ema"]["w"], 7.0)
+
+
 def test_accumulator():
     from fast_autoaugment_tpu.core.metrics import Accumulator
 

@@ -1,0 +1,76 @@
+"""Import a reference PyTorch checkpoint into this framework's format.
+
+The reference publishes trained .pth checkpoints (its README download
+table).  This tool converts one into a fast-autoaugment-tpu msgpack
+checkpoint that ``--only-eval`` / resume can consume:
+
+    python tools/import_checkpoint.py --pth wresnet40x2_cifar10.pth \
+        --model wresnet40_2 --dataset cifar10 --out ckpt/wrn.msgpack
+
+Handles the reference's checkpoint dict layout {'model': state_dict,
+'epoch': ..., 'ema': ...} as well as bare state_dicts, and strips DDP
+'module.' prefixes (reference ``train.py:191-218``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def family_of(model_type: str) -> str:
+    if model_type.startswith("wresnet"):
+        return "wideresnet"
+    if model_type.startswith("resnet"):
+        return "resnet"
+    if model_type.startswith("shakeshake") and "next" not in model_type:
+        return "shakeshake"
+    if model_type == "pyramid":
+        return "pyramid"
+    if model_type.startswith("efficientnet"):
+        return "efficientnet"
+    raise ValueError(f"no importer for model type {model_type!r}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--pth", required=True)
+    p.add_argument("--model", required=True, help="model type (e.g. wresnet40_2)")
+    p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--out", required=True, help="output .msgpack path")
+    args = p.parse_args(argv)
+
+    import torch
+
+    from fast_autoaugment_tpu.core.checkpoint import save_checkpoint
+    from fast_autoaugment_tpu.utils.interop import import_state_dict
+
+    ckpt = torch.load(args.pth, map_location="cpu", weights_only=False)
+    if isinstance(ckpt, dict) and "model" in ckpt:
+        sd, epoch = ckpt["model"], int(ckpt.get("epoch", 0))
+        ema_sd = ckpt.get("ema")
+    else:
+        sd, epoch, ema_sd = ckpt, 0, None
+
+    variables = import_state_dict(sd, family_of(args.model))
+    state = {
+        "step": 0,
+        "params": variables["params"],
+        "batch_stats": variables["batch_stats"],
+    }
+    if ema_sd:
+        ema_vars = import_state_dict(ema_sd, family_of(args.model))
+        state["ema"] = {"params": ema_vars["params"],
+                        "batch_stats": ema_vars["batch_stats"]}
+    save_checkpoint(
+        args.out, state,
+        {"epoch": epoch, "imported_from": args.pth, "has_ema": bool(ema_sd)},
+    )
+    print(f"imported {args.pth} (epoch {epoch}) -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()
