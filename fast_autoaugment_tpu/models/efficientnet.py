@@ -132,6 +132,25 @@ def round_repeats(repeats: int, depth_coefficient: float) -> int:
     return int(math.ceil(depth_coefficient * repeats))
 
 
+def expand_blocks(blocks_args, width_coefficient: float,
+                  depth_coefficient: float) -> list[BlockArgs]:
+    """Apply width/depth scaling and unroll repeats into a flat
+    per-block list (``model.py:166-180``); shared by the module and the
+    checkpoint importer."""
+    expanded: list[BlockArgs] = []
+    for args in blocks_args:
+        args = replace(
+            args,
+            input_filters=round_filters(args.input_filters, width_coefficient),
+            output_filters=round_filters(args.output_filters, width_coefficient),
+            num_repeat=round_repeats(args.num_repeat, depth_coefficient),
+        )
+        expanded.append(args)
+        for _ in range(args.num_repeat - 1):
+            expanded.append(replace(args, input_filters=args.output_filters, stride=1))
+    return expanded
+
+
 def drop_connect(x, key, drop_p: float, train: bool):
     """Reference semantics (``utils.py:92-99``): train -> per-sample
     Bernoulli(1-p) WITHOUT rescaling; eval -> scale by (1-p).  (The
@@ -177,6 +196,11 @@ class CondConv(nn.Module):
         in_ch = x.shape[-1]
         groups = in_ch if self.depthwise else 1
         kshape = (self.kernel_size, self.kernel_size, in_ch // groups, self.features)
+        # the reference's CondConv uses torch-style SYMMETRIC padding
+        # ((s-1)+(k-1))//2 (condconv.py:30-33, default padding=''), NOT
+        # TF SAME like its other convs — match it for checkpoint parity
+        pad = ((self.stride - 1) + (self.kernel_size - 1)) // 2
+        padding = [(pad, pad), (pad, pad)]
         def init_experts(key, _shape):
             # each expert initialized independently (condconv.py:129-139)
             return jnp.stack(
@@ -193,7 +217,7 @@ class CondConv(nn.Module):
                 xi[None],
                 ki,
                 window_strides=(self.stride, self.stride),
-                padding="SAME",
+                padding=padding,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 feature_group_count=groups,
             )[0]
@@ -300,20 +324,7 @@ class EfficientNet(nn.Module):
         x = BatchNorm(momentum=_BN_MOMENTUM_TORCH, epsilon=_BN_EPS, name="bn0")(x, train)
         x = nn.silu(x)
 
-        # expand repeats exactly like the reference (model.py:166-180)
-        expanded: list[BlockArgs] = []
-        for args in self.blocks_args:
-            args = replace(
-                args,
-                input_filters=round_filters(args.input_filters, w),
-                output_filters=round_filters(args.output_filters, w),
-                num_repeat=round_repeats(args.num_repeat, self.depth_coefficient),
-            )
-            expanded.append(args)
-            for _ in range(args.num_repeat - 1):
-                expanded.append(
-                    replace(args, input_filters=args.output_filters, stride=1)
-                )
+        expanded = expand_blocks(self.blocks_args, w, self.depth_coefficient)
         total = len(expanded)
         for idx, args in enumerate(expanded):
             rate = self.drop_connect_rate * float(idx) / total

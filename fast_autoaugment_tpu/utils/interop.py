@@ -82,6 +82,16 @@ def _strip_module(sd: Mapping) -> dict:
     return { (k[7:] if k.startswith("module.") else k): v for k, v in sd.items() }
 
 
+def _scan_blocks(sd: dict) -> list[tuple[int, int]]:
+    """Sorted (stage, index) pairs for torch 'layer{s}.{i}.' names."""
+    blocks: set = set()
+    for key in sd:
+        m = re.match(r"layer(\d+)\.(\d+)\.", key)
+        if m:
+            blocks.add((int(m.group(1)), int(m.group(2))))
+    return sorted(blocks)
+
+
 # ---------------------------------------------------------------------------
 # per-family converters
 # ---------------------------------------------------------------------------
@@ -90,12 +100,7 @@ def _strip_module(sd: Mapping) -> dict:
 def _import_wideresnet(sd: dict) -> dict:
     b = _Builder()
     b.conv(["conv1"], sd, "conv1")
-    stage_blocks: dict = {}
-    for key in sd:
-        m = re.match(r"layer(\d)\.(\d+)\.", key)
-        if m:
-            stage_blocks.setdefault((int(m.group(1)), int(m.group(2))), True)
-    for (stage, i) in sorted(stage_blocks):
+    for (stage, i) in _scan_blocks(sd):
         t = f"layer{stage}.{i}"
         f = f"layer{stage}_{i}"
         b.bn([f, "bn1"], sd, f"{t}.bn1")
@@ -113,12 +118,7 @@ def _import_resnet(sd: dict) -> dict:
     b = _Builder()
     b.conv(["conv1"], sd, "conv1")
     b.bn(["bn1"], sd, "bn1")
-    blocks: set = set()
-    for key in sd:
-        m = re.match(r"layer(\d)\.(\d+)\.", key)
-        if m:
-            blocks.add((int(m.group(1)), int(m.group(2))))
-    for (stage, i) in sorted(blocks):
+    for (stage, i) in _scan_blocks(sd):
         t = f"layer{stage}.{i}"
         f = f"layer{stage}_{i}"
         for conv_i in (1, 2, 3):
@@ -137,12 +137,7 @@ def _import_shake_resnet(sd: dict) -> dict:
     [relu, conv, bn, relu, conv, bn] (reference shake_resnet.py:29-36)."""
     b = _Builder()
     b.conv(["c_in"], sd, "c_in")
-    blocks: set = set()
-    for key in sd:
-        m = re.match(r"layer(\d)\.(\d+)\.", key)
-        if m:
-            blocks.add((int(m.group(1)), int(m.group(2))))
-    for (stage, i) in sorted(blocks):
+    for (stage, i) in _scan_blocks(sd):
         t = f"layer{stage}.{i}"
         f = f"s{stage - 1}_{i}"
         for br in (1, 2):
@@ -167,13 +162,8 @@ def _import_pyramidnet(sd: dict) -> dict:
     b = _Builder()
     b.conv(["conv1"], sd, "conv1")
     b.bn(["bn1"], sd, "bn1")
-    blocks: list = []
-    for key in sd:
-        m = re.match(r"layer(\d)\.(\d+)\.bn1\.weight", key)
-        if m:
-            blocks.append((int(m.group(1)), int(m.group(2))))
     idx = 0
-    for (stage, i) in sorted(blocks):
+    for (stage, i) in _scan_blocks(sd):
         t = f"layer{stage}.{i}"
         f = f"block{idx}"
         for bn_i in (1, 2, 3, 4):
@@ -188,7 +178,41 @@ def _import_pyramidnet(sd: dict) -> dict:
     return b.variables()
 
 
-def _import_efficientnet(sd: dict, blocks_args=None) -> dict:
+def _import_shake_resnext(sd: dict) -> dict:
+    """ShakeResNeXt: branches are torch Sequentials
+    [conv, bn, relu, conv(grouped), bn, relu, conv, bn]
+    (reference shake_resnext.py:29-38); shortcuts only on shape-change
+    blocks (correctly conditional there, unlike ShakeResNet)."""
+    b = _Builder()
+    b.conv(["c_in"], sd, "c_in")
+    for (stage, i) in _scan_blocks(sd):
+        t = f"layer{stage}.{i}"
+        f = f"s{stage - 1}_{i}"
+        for br in (1, 2):
+            b.conv([f"{f}_branch{br}", "conv1"], sd, f"{t}.branch{br}.0")
+            b.bn([f"{f}_branch{br}", "bn1"], sd, f"{t}.branch{br}.1")
+            b.conv([f"{f}_branch{br}", "conv2"], sd, f"{t}.branch{br}.3")
+            b.bn([f"{f}_branch{br}", "bn2"], sd, f"{t}.branch{br}.4")
+            b.conv([f"{f}_branch{br}", "conv3"], sd, f"{t}.branch{br}.6")
+            b.bn([f"{f}_branch{br}", "bn3"], sd, f"{t}.branch{br}.7")
+        if f"{t}.shortcut.conv1.weight" in sd:
+            b.conv([f"{f}_shortcut", "conv1"], sd, f"{t}.shortcut.conv1")
+            b.conv([f"{f}_shortcut", "conv2"], sd, f"{t}.shortcut.conv2")
+            b.bn([f"{f}_shortcut", "bn"], sd, f"{t}.shortcut.bn")
+    b.linear(["fc_out"], sd, "fc_out")
+    return b.variables()
+
+
+def _condconv_experts(flat: np.ndarray, out_ch: int, in_per_group: int, k: int):
+    """[E, out*in_g*k*k] (torch OIHW flattened) -> [E, k, k, in_g, out]."""
+    e = flat.shape[0]
+    w = flat.reshape(e, out_ch, in_per_group, k, k)
+    return np.transpose(w, (0, 3, 4, 2, 1))
+
+
+def _import_efficientnet(sd: dict, model=None) -> dict:
+    """`model` (the target flax EfficientNet) provides per-block shapes
+    needed to unflatten CondConv expert buffers."""
     b = _Builder()
     b.conv(["conv_stem"], sd, "_conv_stem")
     b.bn(["bn0"], sd, "_bn0")
@@ -196,31 +220,56 @@ def _import_efficientnet(sd: dict, blocks_args=None) -> dict:
         int(re.match(r"_blocks\.(\d+)\.", k).group(1))
         for k in sd if k.startswith("_blocks.")
     )
+    expanded = None
+    if model is not None:
+        from fast_autoaugment_tpu.models.efficientnet import expand_blocks
+
+        expanded = expand_blocks(
+            model.blocks_args, model.width_coefficient, model.depth_coefficient
+        )
+        if len(expanded) != n_blocks:
+            raise ValueError(
+                f"model/checkpoint mismatch: target model expands to "
+                f"{len(expanded)} blocks but the checkpoint has {n_blocks} "
+                "— wrong efficientnet variant passed as model=?"
+            )
+
     for i in range(n_blocks):
         t = f"_blocks.{i}"
         f = f"block{i}"
         is_cond = f"{t}.routing_fn.weight" in sd
+        args = expanded[i] if expanded else None
 
-        def cc(flax_name, torch_name, depthwise=False):
-            if is_cond and f"{t}.{torch_name}.weight" in sd:
-                w = np.asarray(sd[f"{t}.{torch_name}.weight"])
-                if w.ndim == 2:  # CondConv experts [E, out*in*k*k]
-                    # shape from the non-expert layout is not recoverable
-                    # from the flat buffer alone; infer via the conv around
-                    raise NotImplementedError(
-                        "CondConv expert import requires block shape info"
+        def cc(flax_name, torch_name, out_ch=None, in_per_group=None, k=None,
+               depthwise=False):
+            w = np.asarray(sd[f"{t}.{torch_name}.weight"])
+            if w.ndim == 2:  # CondConv experts, flat per expert
+                if args is None:
+                    raise ValueError(
+                        "CondConv import needs the target model for shapes; "
+                        "pass model= to import_state_dict"
                     )
-            b.conv([f, flax_name], sd, f"{t}.{torch_name}", depthwise=depthwise)
+                experts = _condconv_experts(w, out_ch, in_per_group, k)
+                _set(b.params, [f, flax_name, "experts"], experts)
+            else:
+                b.conv([f, flax_name], sd, f"{t}.{torch_name}", depthwise=depthwise)
 
+        expanded_ch = args.input_filters * args.expand_ratio if args else None
         if f"{t}._expand_conv.weight" in sd:
-            cc("expand_conv", "_expand_conv")
+            cc("expand_conv", "_expand_conv",
+               out_ch=expanded_ch, in_per_group=args.input_filters if args else None,
+               k=1)
             b.bn([f, "bn0"], sd, f"{t}._bn0")
-        cc("depthwise_conv", "_depthwise_conv", depthwise=True)
+        cc("depthwise_conv", "_depthwise_conv",
+           out_ch=expanded_ch, in_per_group=1,
+           k=args.kernel_size if args else None, depthwise=True)
         b.bn([f, "bn1"], sd, f"{t}._bn1")
         if f"{t}._se_reduce.weight" in sd:
             b.conv([f, "se_reduce"], sd, f"{t}._se_reduce")
             b.conv([f, "se_expand"], sd, f"{t}._se_expand")
-        cc("project_conv", "_project_conv")
+        cc("project_conv", "_project_conv",
+           out_ch=args.output_filters if args else None,
+           in_per_group=expanded_ch, k=1)
         b.bn([f, "bn2"], sd, f"{t}._bn2")
         if is_cond:
             b.linear([f, "routing_fn"], sd, f"{t}.routing_fn")
@@ -234,15 +283,18 @@ _IMPORTERS = {
     "wideresnet": _import_wideresnet,
     "resnet": _import_resnet,
     "shakeshake": _import_shake_resnet,
+    "shakeshake_next": _import_shake_resnext,
     "pyramid": _import_pyramidnet,
     "efficientnet": _import_efficientnet,
 }
 
 
-def import_state_dict(state_dict: Mapping, family: str) -> dict:
+def import_state_dict(state_dict: Mapping, family: str, model=None) -> dict:
     """Convert a reference ``model.state_dict()`` (tensors or ndarrays)
     into flax variables.  `family` in {'wideresnet', 'resnet',
-    'shakeshake', 'pyramid', 'efficientnet'}."""
+    'shakeshake', 'shakeshake_next', 'pyramid', 'efficientnet'}.
+    `model` (the target flax module) is required only for CondConv
+    EfficientNets, whose expert buffers need per-block shapes."""
     sd = { k: np.asarray(getattr(v, "detach", lambda: v)().numpy()
                          if hasattr(v, "numpy") else v)
            for k, v in _strip_module(dict(state_dict)).items() }
@@ -250,4 +302,6 @@ def import_state_dict(state_dict: Mapping, family: str) -> dict:
         importer = _IMPORTERS[family]
     except KeyError:
         raise ValueError(f"unknown family {family!r}; have {sorted(_IMPORTERS)}") from None
+    if family == "efficientnet":
+        return importer(sd, model=model)
     return importer(sd)

@@ -131,3 +131,37 @@ def test_efficientnet_b0_forward_parity(ref):
     variables = import_state_dict(tm.state_dict(), "efficientnet")
     fm = EfficientNet.from_name("efficientnet-b0", num_classes=1000)
     _compare(tm, fm, variables, _input((1, 224, 224, 3)), 2e-3, 2e-3)
+
+
+def test_shake_resnext_forward_parity(ref):
+    base = "/root/reference/FastAutoAugment/networks/"
+    resnext = _load_ref("ref_shake_resnext", base + "shakeshake/shake_resnext.py")
+
+    from fast_autoaugment_tpu.models.shake_resnet import ShakeResNeXt
+
+    tm = resnext.ShakeResNeXt(26, 64, 4, 10)
+    variables = import_state_dict(tm.state_dict(), "shakeshake_next")
+    _compare(tm, ShakeResNeXt(depth=26, w_base=64, cardinality=4, num_classes=10),
+             variables, _input((2, 32, 32, 3)), 1e-3, 1e-3)
+
+
+def test_efficientnet_b0_condconv_forward_parity(ref):
+    from fast_autoaugment_tpu.models.efficientnet import EfficientNet
+
+    tm = ref["efficientnet"].EfficientNet.from_name(
+        "efficientnet-b0", condconv_num_expert=4
+    )
+    fm = EfficientNet.from_name("efficientnet-b0", num_classes=1000,
+                                condconv_num_expert=4)
+    variables = import_state_dict(tm.state_dict(), "efficientnet", model=fm)
+    # the reference initializes CondConv experts with fan_out computed on
+    # the FLAT [E, prod] buffer (condconv.py:129-137) -> std ~0.7, so an
+    # untrained condconv model's logits explode to ~1e10; per-element
+    # rtol is meaningless near zero — use range-relative tolerance
+    tm.eval()
+    with torch.no_grad():
+        x_np = _input((1, 224, 224, 3))
+        want = tm(torch.tensor(np.transpose(x_np, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(fm.apply(variables, jnp.asarray(x_np), train=False))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() <= 1e-4 * scale

@@ -26,8 +26,8 @@ def family_of(model_type: str) -> str:
         return "wideresnet"
     if model_type.startswith("resnet"):
         return "resnet"
-    if model_type.startswith("shakeshake") and "next" not in model_type:
-        return "shakeshake"
+    if model_type.startswith("shakeshake"):
+        return "shakeshake_next" if "next" in model_type else "shakeshake"
     if model_type == "pyramid":
         return "pyramid"
     if model_type.startswith("efficientnet"):
@@ -40,6 +40,8 @@ def main(argv=None):
     p.add_argument("--pth", required=True)
     p.add_argument("--model", required=True, help="model type (e.g. wresnet40_2)")
     p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--condconv-num-expert", type=int, default=0,
+                   help="expert count for efficientnet-*-condconv checkpoints")
     p.add_argument("--out", required=True, help="output .msgpack path")
     args = p.parse_args(argv)
 
@@ -55,14 +57,26 @@ def main(argv=None):
     else:
         sd, epoch, ema_sd = ckpt, 0, None
 
-    variables = import_state_dict(sd, family_of(args.model))
+    family = family_of(args.model)
+    flax_model = None
+    if family == "efficientnet":
+        # CondConv expert unflattening needs the target model's block shapes
+        from fast_autoaugment_tpu.models import get_model, num_class
+
+        flax_model = get_model(
+            {"type": args.model, "dataset": args.dataset,
+             "condconv_num_expert": args.condconv_num_expert},
+            num_class(args.dataset),
+        )
+
+    variables = import_state_dict(sd, family, model=flax_model)
     state = {
         "step": 0,
         "params": variables["params"],
         "batch_stats": variables["batch_stats"],
     }
     if ema_sd:
-        ema_vars = import_state_dict(ema_sd, family_of(args.model))
+        ema_vars = import_state_dict(ema_sd, family, model=flax_model)
         state["ema"] = {"params": ema_vars["params"],
                         "batch_stats": ema_vars["batch_stats"]}
     save_checkpoint(
