@@ -59,9 +59,8 @@ def get_model(conf: Any, num_classes: int) -> nn.Module:
     """
     name = conf["type"]
     dataset = conf.get("dataset", "cifar")
-    # mixed precision: 'bf16' runs activations in bfloat16 (params and BN
-    # statistics stay float32); currently threaded through the WRN/ResNet
-    # families — the headline benchmark models
+    # mixed precision: 'bf16' runs activations in bfloat16 (params, BN
+    # statistics and logits stay float32) — threaded through every family
     precision = str(conf.get("precision", "f32") or "f32").lower()
     import jax.numpy as jnp
 
@@ -87,20 +86,16 @@ def get_model(conf: Any, num_classes: int) -> nn.Module:
             dropout_rate=0.0,
             dtype=dtype,
         )
-    if dtype is not jnp.float32:
-        raise ValueError(
-            f"precision={precision} is not yet supported for model {name!r} "
-            "(bf16 is threaded through wresnet*/resnet* so far)"
-        )
     if name.startswith("shakeshake26_2x"):
         rest = name[len("shakeshake26_2x"):]
         if rest.endswith("d_next"):
             return ShakeResNeXt(
                 depth=26, w_base=int(rest[:-len("d_next")]), cardinality=4,
-                num_classes=num_classes,
+                num_classes=num_classes, dtype=dtype,
             )
         assert rest.endswith("d")
-        return ShakeResNet(depth=26, w_base=int(rest[:-1]), num_classes=num_classes)
+        return ShakeResNet(depth=26, w_base=int(rest[:-1]), num_classes=num_classes,
+                           dtype=dtype)
     if name == "pyramid":
         return PyramidNet(
             dataset=dataset if dataset.startswith("cifar") else "cifar10",
@@ -108,6 +103,7 @@ def get_model(conf: Any, num_classes: int) -> nn.Module:
             alpha=float(conf["alpha"]),
             num_classes=num_classes,
             bottleneck=bool(conf.get("bottleneck", True)),
+            dtype=dtype,
         )
     if name.startswith("efficientnet"):
         from fast_autoaugment_tpu.models.efficientnet import EfficientNet
@@ -118,5 +114,6 @@ def get_model(conf: Any, num_classes: int) -> nn.Module:
             base,
             num_classes=num_classes,
             condconv_num_expert=int(conf.get("condconv_num_expert", 0)) if condconv else 0,
+            dtype=dtype,
         )
     raise ValueError(f"unknown model type {name!r}")

@@ -36,7 +36,7 @@ from __future__ import annotations
 import math
 import re
 from dataclasses import dataclass, replace
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -161,7 +161,8 @@ def drop_connect(x, key, drop_p: float, train: bool):
     return x * keep.astype(x.dtype)
 
 
-def _conv_same(features, kernel, stride=1, groups=1, bias=False, name=None):
+def _conv_same(features, kernel, stride=1, groups=1, bias=False, dtype=None,
+               name=None):
     return nn.Conv(
         features,
         (kernel, kernel),
@@ -171,6 +172,7 @@ def _conv_same(features, kernel, stride=1, groups=1, bias=False, name=None):
         use_bias=bias,
         kernel_init=conv_tf_init,
         bias_init=nn.initializers.zeros,
+        dtype=dtype,
         name=name,
     )
 
@@ -190,9 +192,12 @@ class CondConv(nn.Module):
     num_experts: int
     stride: int = 1
     depthwise: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, routing_weights):
+        x = x.astype(self.dtype)
+        routing_weights = routing_weights.astype(self.dtype)
         in_ch = x.shape[-1]
         groups = in_ch if self.depthwise else 1
         kshape = (self.kernel_size, self.kernel_size, in_ch // groups, self.features)
@@ -210,7 +215,8 @@ class CondConv(nn.Module):
 
         experts = self.param("experts", init_experts, (self.num_experts,) + kshape)
         # per-sample kernels: [B, kh, kw, cin/g, cout]
-        kernels = jnp.einsum("be,ehwio->bhwio", routing_weights, experts)
+        kernels = jnp.einsum("be,ehwio->bhwio", routing_weights,
+                             experts.astype(self.dtype))
 
         def conv_one(xi, ki):
             return jax.lax.conv_general_dilated(
@@ -229,6 +235,7 @@ class MBConvBlock(nn.Module):
     """Mobile inverted bottleneck with SE (``model.py:22-123``)."""
 
     args: BlockArgs
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool, drop_connect_rate: float = 0.0):
@@ -251,13 +258,14 @@ class MBConvBlock(nn.Module):
 
             def conv(features, kernel, stride=1, depthwise=False, name=None):
                 return lambda h: CondConv(
-                    features, kernel, a.condconv_num_expert, stride, depthwise, name=name
+                    features, kernel, a.condconv_num_expert, stride, depthwise,
+                    dtype=self.dtype, name=name
                 )(h, routing)
         else:
             def conv(features, kernel, stride=1, depthwise=False, name=None):
                 return _conv_same(
                     features, kernel, stride,
-                    groups=expanded if depthwise else 1, name=name,
+                    groups=expanded if depthwise else 1, dtype=self.dtype, name=name,
                 )
 
         if a.expand_ratio != 1:
@@ -272,9 +280,11 @@ class MBConvBlock(nn.Module):
         if a.se_ratio is not None and 0 < a.se_ratio <= 1:
             squeezed = max(1, int(a.input_filters * a.se_ratio))
             se = x.mean(axis=(1, 2), keepdims=True)
-            se = _conv_same(squeezed, 1, bias=True, name="se_reduce")(se)
+            se = _conv_same(squeezed, 1, bias=True, dtype=self.dtype,
+                            name="se_reduce")(se)
             se = nn.silu(se)
-            se = _conv_same(expanded, 1, bias=True, name="se_expand")(se)
+            se = _conv_same(expanded, 1, bias=True, dtype=self.dtype,
+                            name="se_expand")(se)
             x = nn.sigmoid(se) * x
 
         x = conv(a.output_filters, 1, name="project_conv")(x)
@@ -298,10 +308,11 @@ class EfficientNet(nn.Module):
     dropout_rate: float
     num_classes: int
     drop_connect_rate: float = 0.2
+    dtype: Any = jnp.float32
 
     @classmethod
     def from_name(cls, model_name: str, num_classes: int = 1000,
-                  condconv_num_expert: int = 0) -> "EfficientNet":
+                  condconv_num_expert: int = 0, dtype=jnp.float32) -> "EfficientNet":
         width, depth, _res, dropout = efficientnet_params(model_name)
         blocks = [decode_block_string(s) for s in _BLOCK_STRINGS]
         if condconv_num_expert > 1:
@@ -315,12 +326,15 @@ class EfficientNet(nn.Module):
             depth_coefficient=depth,
             dropout_rate=dropout,
             num_classes=num_classes,
+            dtype=dtype,
         )
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         w = self.width_coefficient
-        x = _conv_same(round_filters(32, w), 3, 2, name="conv_stem")(x)
+        x = x.astype(self.dtype)
+        x = _conv_same(round_filters(32, w), 3, 2, dtype=self.dtype,
+                       name="conv_stem")(x)
         x = BatchNorm(momentum=_BN_MOMENTUM_TORCH, epsilon=_BN_EPS, name="bn0")(x, train)
         x = nn.silu(x)
 
@@ -328,12 +342,14 @@ class EfficientNet(nn.Module):
         total = len(expanded)
         for idx, args in enumerate(expanded):
             rate = self.drop_connect_rate * float(idx) / total
-            x = MBConvBlock(args, name=f"block{idx}")(x, train, drop_connect_rate=rate)
+            x = MBConvBlock(args, dtype=self.dtype,
+                            name=f"block{idx}")(x, train, drop_connect_rate=rate)
 
-        x = _conv_same(round_filters(1280, w), 1, name="conv_head")(x)
+        x = _conv_same(round_filters(1280, w), 1, dtype=self.dtype,
+                       name="conv_head")(x)
         x = BatchNorm(momentum=_BN_MOMENTUM_TORCH, epsilon=_BN_EPS, name="bn1")(x, train)
         x = nn.silu(x)
-        x = x.mean(axis=(1, 2))
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
         if self.dropout_rate > 0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return nn.Dense(

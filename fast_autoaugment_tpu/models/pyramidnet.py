@@ -15,6 +15,8 @@ as floats and round per block, with the block input tracked as
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -28,7 +30,7 @@ from fast_autoaugment_tpu.ops.shake import (
 __all__ = ["PyramidNet", "pyramidnet_plan"]
 
 
-def _conv(features, kernel, stride=1, name=None):
+def _conv(features, kernel, stride=1, dtype=None, name=None):
     return nn.Conv(
         features,
         (kernel, kernel),
@@ -36,6 +38,7 @@ def _conv(features, kernel, stride=1, name=None):
         padding=[(kernel // 2, kernel // 2)] * 2,
         use_bias=False,
         kernel_init=he_normal_fanout,
+        dtype=dtype,
         name=name,
     )
 
@@ -84,14 +87,15 @@ class PyramidBasicBlock(nn.Module):
     features: int
     stride: int
     p_shakedrop: float
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         out = BatchNorm(name="bn1")(x, train)
-        out = _conv(self.features, 3, self.stride, name="conv1")(out)
+        out = _conv(self.features, 3, self.stride, dtype=self.dtype, name="conv1")(out)
         out = BatchNorm(name="bn2")(out, train)
         out = nn.relu(out)
-        out = _conv(self.features, 3, 1, name="conv2")(out)
+        out = _conv(self.features, 3, 1, dtype=self.dtype, name="conv2")(out)
         out = BatchNorm(name="bn3")(out, train)
         out = _ShakeDropGate(self.p_shakedrop, name="shake_drop")(out, train)
         return _shortcut_add(x, out, self.stride)
@@ -105,17 +109,18 @@ class PyramidBottleneck(nn.Module):
     stride: int
     p_shakedrop: float
     expansion: int = 4
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         out = BatchNorm(name="bn1")(x, train)
-        out = _conv(self.features, 1, name="conv1")(out)
+        out = _conv(self.features, 1, dtype=self.dtype, name="conv1")(out)
         out = BatchNorm(name="bn2")(out, train)
         out = nn.relu(out)
-        out = _conv(self.features, 3, self.stride, name="conv2")(out)
+        out = _conv(self.features, 3, self.stride, dtype=self.dtype, name="conv2")(out)
         out = BatchNorm(name="bn3")(out, train)
         out = nn.relu(out)
-        out = _conv(self.features * self.expansion, 1, name="conv3")(out)
+        out = _conv(self.features * self.expansion, 1, dtype=self.dtype, name="conv3")(out)
         out = BatchNorm(name="bn4")(out, train)
         out = _ShakeDropGate(self.p_shakedrop, name="shake_drop")(out, train)
         return _shortcut_add(x, out, self.stride)
@@ -150,16 +155,19 @@ class PyramidNet(nn.Module):
     alpha: float
     num_classes: int
     bottleneck: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
         plan, expansion = pyramidnet_plan(self.depth, self.alpha, self.bottleneck)
         block = PyramidBottleneck if self.bottleneck else PyramidBasicBlock
-        out = _conv(16, 3, 1, name="conv1")(x)
+        out = _conv(16, 3, 1, dtype=self.dtype, name="conv1")(x)
         out = BatchNorm(name="bn1")(out, train)
         for idx, (width, stride, p_sd) in enumerate(plan):
-            out = block(width, stride, p_sd, name=f"block{idx}")(out, train)
+            out = block(width, stride, p_sd, dtype=self.dtype,
+                        name=f"block{idx}")(out, train)
         out = BatchNorm(name="bn_final")(out, train)
         out = nn.relu(out)
-        out = global_avg_pool(out)
+        out = global_avg_pool(out).astype(jnp.float32)
         return nn.Dense(self.num_classes, name="fc")(out)

@@ -15,6 +15,8 @@ Noise keys come from the ``'shake'`` RNG collection when ``train=True``.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -28,7 +30,7 @@ from fast_autoaugment_tpu.ops.shake import (
 __all__ = ["ShakeResNet", "ShakeResNeXt"]
 
 
-def _conv(features, kernel, stride=1, groups=1, bias=False, name=None):
+def _conv(features, kernel, stride=1, groups=1, bias=False, dtype=None, name=None):
     return nn.Conv(
         features,
         (kernel, kernel),
@@ -37,6 +39,7 @@ def _conv(features, kernel, stride=1, groups=1, bias=False, name=None):
         feature_group_count=groups,
         use_bias=bias,
         kernel_init=he_normal_fanout,
+        dtype=dtype,
         name=name,
     )
 
@@ -51,16 +54,17 @@ class Shortcut(nn.Module):
 
     out_ch: int
     stride: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.relu(x)
         s = self.stride
         h1 = h[:, ::s, ::s, :]
-        h1 = _conv(self.out_ch // 2, 1, name="conv1")(h1)
+        h1 = _conv(self.out_ch // 2, 1, dtype=self.dtype, name="conv1")(h1)
         # F.pad(h, (-1, 1, -1, 1)): crop first row/col, pad one at the end
         h2 = jnp.pad(h[:, 1:, 1:, :], ((0, 0), (0, 1), (0, 1), (0, 0)))[:, ::s, ::s, :]
-        h2 = _conv(self.out_ch // 2, 1, name="conv2")(h2)
+        h2 = _conv(self.out_ch // 2, 1, dtype=self.dtype, name="conv2")(h2)
         return BatchNorm(name="bn")(jnp.concatenate([h1, h2], axis=-1), train)
 
 
@@ -69,14 +73,15 @@ class _ShakeBranchBasic(nn.Module):
 
     out_ch: int
     stride: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.relu(x)
-        h = _conv(self.out_ch, 3, self.stride, name="conv1")(h)
+        h = _conv(self.out_ch, 3, self.stride, dtype=self.dtype, name="conv1")(h)
         h = BatchNorm(name="bn1")(h, train)
         h = nn.relu(h)
-        h = _conv(self.out_ch, 3, 1, name="conv2")(h)
+        h = _conv(self.out_ch, 3, 1, dtype=self.dtype, name="conv2")(h)
         return BatchNorm(name="bn2")(h, train)
 
 
@@ -87,14 +92,16 @@ class _ShakeBranchBottleneck(nn.Module):
     out_ch: int
     cardinality: int
     stride: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
-        h = _conv(self.mid_ch, 1, name="conv1")(x)
+        h = _conv(self.mid_ch, 1, dtype=self.dtype, name="conv1")(x)
         h = nn.relu(BatchNorm(name="bn1")(h, train))
-        h = _conv(self.mid_ch, 3, self.stride, groups=self.cardinality, name="conv2")(h)
+        h = _conv(self.mid_ch, 3, self.stride, groups=self.cardinality,
+                  dtype=self.dtype, name="conv2")(h)
         h = nn.relu(BatchNorm(name="bn2")(h, train))
-        h = _conv(self.out_ch, 1, name="conv3")(h)
+        h = _conv(self.out_ch, 1, dtype=self.dtype, name="conv3")(h)
         return BatchNorm(name="bn3")(h, train)
 
 
@@ -116,27 +123,32 @@ class ShakeResNet(nn.Module):
     depth: int
     w_base: int
     num_classes: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
         n_units = (self.depth - 2) // 6
         chs = (16, self.w_base, self.w_base * 2, self.w_base * 4)
-        h = _conv(chs[0], 3, bias=True, name="c_in")(x)
+        h = _conv(chs[0], 3, bias=True, dtype=self.dtype, name="c_in")(x)
         for stage in range(3):
             out_ch = chs[stage + 1]
             for i in range(n_units):
                 stride = 2 if (stage > 0 and i == 0) else 1
                 in_ch = h.shape[-1]
-                h1 = _ShakeBranchBasic(out_ch, stride, name=f"s{stage}_{i}_branch1")(h, train)
-                h2 = _ShakeBranchBasic(out_ch, stride, name=f"s{stage}_{i}_branch2")(h, train)
+                h1 = _ShakeBranchBasic(out_ch, stride, dtype=self.dtype,
+                                       name=f"s{stage}_{i}_branch1")(h, train)
+                h2 = _ShakeBranchBasic(out_ch, stride, dtype=self.dtype,
+                                       name=f"s{stage}_{i}_branch2")(h, train)
                 mixed = _ShakeMix(name=f"s{stage}_{i}_mix")(h1, h2, train)
                 if in_ch == out_ch:
                     h0 = h
                 else:
-                    h0 = Shortcut(out_ch, stride, name=f"s{stage}_{i}_shortcut")(h, train)
+                    h0 = Shortcut(out_ch, stride, dtype=self.dtype,
+                                  name=f"s{stage}_{i}_shortcut")(h, train)
                 h = mixed + h0
         h = nn.relu(h)
-        h = global_avg_pool(h)
+        h = global_avg_pool(h).astype(jnp.float32)
         return nn.Dense(self.num_classes, bias_init=nn.initializers.zeros, name="fc_out")(h)
 
 
@@ -148,12 +160,14 @@ class ShakeResNeXt(nn.Module):
     w_base: int
     cardinality: int
     num_classes: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
         n_units = (self.depth - 2) // 9
         n_chs = (64, 128, 256, 1024)
-        h = _conv(n_chs[0], 3, bias=True, name="c_in")(x)
+        h = _conv(n_chs[0], 3, bias=True, dtype=self.dtype, name="c_in")(x)
         for stage in range(3):
             mid_ch = n_chs[stage] * (self.w_base // 64) * self.cardinality
             out_ch = n_chs[stage] * 4
@@ -161,17 +175,20 @@ class ShakeResNeXt(nn.Module):
                 stride = 2 if (stage > 0 and i == 0) else 1
                 in_ch = h.shape[-1]
                 h1 = _ShakeBranchBottleneck(
-                    mid_ch, out_ch, self.cardinality, stride, name=f"s{stage}_{i}_branch1"
+                    mid_ch, out_ch, self.cardinality, stride, dtype=self.dtype,
+                    name=f"s{stage}_{i}_branch1"
                 )(h, train)
                 h2 = _ShakeBranchBottleneck(
-                    mid_ch, out_ch, self.cardinality, stride, name=f"s{stage}_{i}_branch2"
+                    mid_ch, out_ch, self.cardinality, stride, dtype=self.dtype,
+                    name=f"s{stage}_{i}_branch2"
                 )(h, train)
                 mixed = _ShakeMix(name=f"s{stage}_{i}_mix")(h1, h2, train)
                 if in_ch == out_ch:
                     h0 = h
                 else:
-                    h0 = Shortcut(out_ch, stride, name=f"s{stage}_{i}_shortcut")(h, train)
+                    h0 = Shortcut(out_ch, stride, dtype=self.dtype,
+                                  name=f"s{stage}_{i}_shortcut")(h, train)
                 h = mixed + h0
         h = nn.relu(h)
-        h = global_avg_pool(h)
+        h = global_avg_pool(h).astype(jnp.float32)
         return nn.Dense(self.num_classes, bias_init=nn.initializers.zeros, name="fc_out")(h)

@@ -89,6 +89,7 @@ def test_train_with_mixup_ema_default_aug():
         assert "top1_test_ema" in result
 
 
+@pytest.mark.slow
 def test_bf16_precision_smoke():
     """bf16 activations: params/logits stay f32, training runs, and the
     f32-vs-bf16 forward agree to bf16 tolerance."""
@@ -106,9 +107,22 @@ def test_bf16_precision_smoke():
     assert o16.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(o32), np.asarray(o16), atol=5e-2)
 
-    with pytest.raises(ValueError, match="not yet supported"):
-        get_model({"type": "pyramid", "precision": "bf16", "depth": 11,
-                   "alpha": 4, "bottleneck": False}, 10)
+    # every family accepts bf16 now; unknown strings raise
+    for conf in (
+        {"type": "pyramid", "precision": "bf16", "depth": 11, "alpha": 4,
+         "bottleneck": False},
+        {"type": "shakeshake26_2x32d", "precision": "bf16"},
+        {"type": "efficientnet-b0", "precision": "bf16"},
+    ):
+        m = get_model(conf, 10)
+        vv = m.init({"params": jax.random.PRNGKey(0),
+                     "shake": jax.random.PRNGKey(1)},
+                    jnp.zeros((1, 32, 32, 3)), train=False)
+        out = m.apply(vv, jnp.zeros((1, 32, 32, 3)), train=False)
+        assert out.dtype == jnp.float32
+        assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(vv["params"]))
+    with pytest.raises(ValueError, match="unknown precision"):
+        get_model({"type": "wresnet10_1", "precision": "fp16"}, 10)
 
 
 def test_ema_interval_restores_weights():
