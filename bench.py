@@ -17,14 +17,20 @@ vs_baseline = value / 1500.
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 REFERENCE_IMAGES_PER_SEC = 1500.0
-BATCH_PER_DEVICE = 128
-WARMUP_STEPS = 5
-MEASURE_STEPS = 30
+BATCH_PER_DEVICE = int(os.environ.get("FAA_BENCH_BATCH", 128))
+WARMUP_STEPS = int(os.environ.get("FAA_BENCH_WARMUP", 5))
+MEASURE_STEPS = int(os.environ.get("FAA_BENCH_STEPS", 30))
+
+
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
 def main():
@@ -67,9 +73,16 @@ def main():
     labels = np.random.default_rng(1).integers(0, 10, (global_batch,), np.int32)
     batch = shard_batch(mesh, {"x": images, "y": labels})
 
-    for _ in range(WARMUP_STEPS):
+    _log(f"devices={n_dev} global_batch={global_batch}; compiling train step "
+         "(first TPU compile can take minutes)")
+    t_compile = time.perf_counter()
+    for i in range(WARMUP_STEPS):
         state, metrics = train_step(state, batch["x"], batch["y"], policy, rng)
+        if i == 0:
+            jax.block_until_ready(state.params)
+            _log(f"compile+first step: {time.perf_counter() - t_compile:.1f}s")
     jax.block_until_ready(state.params)
+    _log(f"warmup done; measuring {MEASURE_STEPS} steps")
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
