@@ -24,9 +24,11 @@ import time
 import numpy as np
 
 REFERENCE_IMAGES_PER_SEC = 1500.0
-BATCH_PER_DEVICE = int(os.environ.get("FAA_BENCH_BATCH", 128))
-WARMUP_STEPS = int(os.environ.get("FAA_BENCH_WARMUP", 5))
-MEASURE_STEPS = int(os.environ.get("FAA_BENCH_STEPS", 30))
+BATCH_PER_DEVICE = max(1, int(os.environ.get("FAA_BENCH_BATCH", 128)))
+# floors: warmup 0 would put the multi-minute first compile inside the
+# timed loop and silently wreck the headline number
+WARMUP_STEPS = max(1, int(os.environ.get("FAA_BENCH_WARMUP", 5)))
+MEASURE_STEPS = max(1, int(os.environ.get("FAA_BENCH_STEPS", 30)))
 
 
 def _log(msg):

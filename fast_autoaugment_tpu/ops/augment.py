@@ -147,14 +147,19 @@ def _warp_affine_nearest(img: jax.Array, mat: jax.Array) -> jax.Array:
 
 
 def _histogram256(channel_int: jax.Array) -> jax.Array:
-    """256-bin histogram as a one-hot reduction.
+    """256-bin histogram via sort + searchsorted.
 
-    Scatter-adds serialize on TPU; a [N, 256] one-hot contraction rides
-    the MXU/VPU instead and vmaps cleanly over the batch.
+    Scatter-adds serialize on TPU and a [N, 256] one-hot materializes
+    ~100x more intermediate data; sorting the N pixels and differencing
+    bin-edge ranks is ~9x faster (measured in tools/bench_aug.py — the
+    histogram made Equalize the single hottest augmentation op) and
+    vmaps cleanly.
     """
     flat = channel_int.reshape(-1)
-    onehot = jax.nn.one_hot(flat, 256, dtype=jnp.int32)
-    return onehot.sum(axis=0)
+    s = jnp.sort(flat)
+    edges = jnp.arange(257, dtype=jnp.int32)
+    ranks = jnp.searchsorted(s, edges, side="left").astype(jnp.int32)
+    return jnp.diff(ranks)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,82 @@
+"""Micro-benchmark for the on-device augmentation engine.
+
+Times each augmentation op (vmapped over a batch), the full policy
+application, and the complete CIFAR train-time stack — the pieces that
+replace the reference's 8-worker PIL pipeline (``data.py:214-224``).
+Run on TPU (plain env) or CPU mesh for relative numbers:
+
+    python tools/bench_aug.py [--batch 128] [--steps 20]
+
+Prints a per-op table plus the policy/stack totals; useful for deciding
+whether any op deserves a Pallas kernel (so far XLA fusion has been
+sufficient — the full 493-sub-policy stack is a small fraction of a
+WRN-40-2 train step).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fast_autoaugment_tpu.ops import augment as A
+    from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
+    from fast_autoaugment_tpu.policies.archive import load_policy, policy_to_tensor
+
+    images = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, 256, (args.batch, args.size, args.size, 3), dtype=np.uint8
+        ),
+        jnp.float32,
+    )
+    key = jax.random.PRNGKey(0)
+
+    def timed(fn, *fn_args):
+        out = fn(*fn_args)
+        jax.block_until_ready(out)  # compile
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = fn(*fn_args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.steps * 1e3  # ms
+
+    print(f"backend={jax.devices()[0].platform} batch={args.batch} "
+          f"size={args.size} steps={args.steps}")
+    print(f"{'op':<16} {'ms/batch':>10} {'us/image':>10}")
+    for idx, name in enumerate(A.OP_NAMES):
+        fn = jax.jit(
+            lambda imgs, k, i=idx: jax.vmap(
+                lambda im, kk: A.apply_op(im, jnp.int32(i), jnp.float32(0.7), kk)
+            )(imgs, jax.random.split(k, imgs.shape[0]))
+        )
+        ms = timed(fn, images, key)
+        print(f"{name:<16} {ms:>10.3f} {ms / args.batch * 1e3:>10.1f}")
+
+    policy = jnp.asarray(policy_to_tensor(load_policy("fa_reduced_cifar10")))
+    fn = jax.jit(lambda imgs, k: A.apply_policy_batch(imgs, policy, k))
+    ms = timed(fn, images, key)
+    print(f"{'policy(493)':<16} {ms:>10.3f} {ms / args.batch * 1e3:>10.1f}")
+
+    fn = jax.jit(lambda imgs, k: cifar_train_batch(imgs, k, policy=policy,
+                                                   cutout_length=16))
+    ms = timed(fn, images, key)
+    print(f"{'full stack':<16} {ms:>10.3f} {ms / args.batch * 1e3:>10.1f}")
+
+
+if __name__ == "__main__":
+    main()
