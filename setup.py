@@ -8,4 +8,11 @@ setup(
     package_data={"fast_autoaugment_tpu.policies": ["data/*.json"]},
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "numpy", "pyyaml", "msgpack"],
+    entry_points={
+        "console_scripts": [
+            "faa-train=fast_autoaugment_tpu.launch.train_cli:main",
+            "faa-search=fast_autoaugment_tpu.launch.search_cli:main",
+            "faa-fleet=fast_autoaugment_tpu.launch.fleet:main",
+        ]
+    },
 )

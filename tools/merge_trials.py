@@ -1,0 +1,57 @@
+"""Merge per-host search trial logs for the --folds scatter flow.
+
+Each host runs ``search_cli --folds k --save-dir <its own dir>``; this
+tool merges their ``search_trials.json`` files (and copies fold
+checkpoints when present) into one save-dir, after which rerunning
+``search_cli`` there resumes instantly and emits the combined final
+policy set:
+
+    python tools/merge_trials.py --into merged_dir host0_dir host1_dir ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--into", required=True, help="destination save-dir")
+    p.add_argument("sources", nargs="+", help="per-host save-dirs")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.into, exist_ok=True)
+    dest_trials_path = os.path.join(args.into, "search_trials.json")
+    merged: dict = {}
+    if os.path.exists(dest_trials_path):
+        with open(dest_trials_path) as fh:
+            merged = json.load(fh)
+
+    for src in args.sources:
+        trials_path = os.path.join(src, "search_trials.json")
+        if os.path.exists(trials_path):
+            with open(trials_path) as fh:
+                for fold, trials in json.load(fh).items():
+                    # keep whichever side has MORE trials for a fold
+                    if len(trials) > len(merged.get(fold, [])):
+                        merged[fold] = trials
+        for ckpt in glob.glob(os.path.join(src, "*.msgpack*")):
+            dst = os.path.join(args.into, os.path.basename(ckpt))
+            if not os.path.exists(dst) and os.path.abspath(ckpt) != os.path.abspath(dst):
+                shutil.copy2(ckpt, dst)
+
+    with open(dest_trials_path, "w") as fh:
+        json.dump(merged, fh)
+    print(
+        f"merged {len(args.sources)} dirs -> {args.into}: folds "
+        f"{sorted(merged, key=int)} with "
+        f"{[len(merged[k]) for k in sorted(merged, key=int)]} trials"
+    )
+
+
+if __name__ == "__main__":
+    main()
