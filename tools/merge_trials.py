@@ -31,6 +31,10 @@ def main(argv=None):
         with open(dest_trials_path) as fh:
             merged = json.load(fh)
 
+    # a fold's trial rewards were produced against THAT host's fold
+    # checkpoint — trials and checkpoint must travel together, or resumed
+    # TPE runs would mix rewards from two differently-initialized models
+    fold_source: dict[str, str] = {}
     for src in args.sources:
         trials_path = os.path.join(src, "search_trials.json")
         if os.path.exists(trials_path):
@@ -39,9 +43,25 @@ def main(argv=None):
                     # keep whichever side has MORE trials for a fold
                     if len(trials) > len(merged.get(fold, [])):
                         merged[fold] = trials
+                        fold_source[fold] = src
+
+    for src in args.sources:
         for ckpt in glob.glob(os.path.join(src, "*.msgpack*")):
-            dst = os.path.join(args.into, os.path.basename(ckpt))
-            if not os.path.exists(dst) and os.path.abspath(ckpt) != os.path.abspath(dst):
+            name = os.path.basename(ckpt)
+            if name.endswith(".tmp"):
+                continue
+            dst = os.path.join(args.into, name)
+            if os.path.abspath(ckpt) == os.path.abspath(dst):
+                continue
+            owner = next(
+                (s for fold, s in fold_source.items() if f"fold{fold}_" in name), None
+            )
+            if owner is not None:
+                # fold checkpoint: always take it from the host whose
+                # trials won the merge for that fold
+                if owner == src:
+                    shutil.copy2(ckpt, dst)
+            elif not os.path.exists(dst):
                 shutil.copy2(ckpt, dst)
 
     with open(dest_trials_path, "w") as fh:
