@@ -1,0 +1,28 @@
+"""The driver's `dryrun_multichip` must pass without real chips.
+
+Round-1 failure mode: the dryrun inherited the ambient single-chip TPU
+environment and hung in backend init (MULTICHIP_r01.json rc=124).  The
+entry point now unconditionally re-execs into a forced-CPU subprocess;
+this test runs it exactly the way the driver does — ambient environment,
+no special setup — and must finish well inside the driver's timeout.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_passes_under_ambient_env():
+    # Deliberately do NOT scrub the environment: the point is that the
+    # entry point itself must survive whatever the driver inherits.
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4)"],
+        cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
