@@ -154,18 +154,38 @@ def eval_batches(
     indices: np.ndarray | None,
     batch: int,
     *,
+    process_index: int = 0,
+    process_count: int = 1,
+    pad_multiple: int = 1,
     decode_size: int | None = None,
     host_transform=None,
     box_fn=None,
     imgsize: int | None = None,
     size_cache: "SizeCache | None" = None,
-) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Deterministic eval batches (SubsetSampler semantics,
-    ``data.py:348-362``); final partial batch kept."""
+    ``data.py:348-362``); final partial batch kept, padded by repeating
+    its last sample so the global batch divides `pad_multiple` (pass the
+    mesh size) and `process_count`; `mask` is 1.0 for real samples.
+
+    Yields ``(images, labels, mask)``.  Multi-host: each process decodes
+    and yields only its [process_index] contiguous shard of every global
+    batch — eval work is sharded across hosts exactly like
+    `train_batches`, not duplicated per host.
+    """
     idx = np.arange(len(dataset)) if indices is None else np.asarray(indices)
     rng = np.random.default_rng(0)  # eval box_fns ignore the rng
+    multiple = int(np.lcm(max(1, pad_multiple), max(1, process_count)))
     for s in range(0, len(idx), batch):
         chunk = idx[s:s + batch]
+        n = len(chunk)
+        pad = (-n) % multiple
+        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        if pad:
+            chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad)])
+        shard = len(chunk) // process_count
+        lo = process_index * shard
+        chunk, mask = chunk[lo:lo + shard], mask[lo:lo + shard]
         images = dataset.images[chunk]
         if dataset.lazy:
             if box_fn is not None:
@@ -173,7 +193,7 @@ def eval_batches(
                                        size_cache or SizeCache())
             else:
                 images = _decode(images, host_transform, decode_size)
-        yield images, dataset.labels[chunk]
+        yield images, dataset.labels[chunk], mask
 
 
 def num_train_steps(n_examples: int, global_batch: int) -> int:
@@ -234,10 +254,10 @@ class BatchIterator:
             size_cache=self.size_cache, **kw,
         )
 
-    def eval_epoch(self, batch):
+    def eval_epoch(self, batch, **kw):
         return eval_batches(
             self.dataset, self.indices, batch, decode_size=self.decode_size,
             host_transform=self.eval_transform,
             box_fn=self.eval_box_fn, imgsize=self.imgsize,
-            size_cache=self.size_cache,
+            size_cache=self.size_cache, **kw,
         )

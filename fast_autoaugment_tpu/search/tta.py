@@ -27,7 +27,6 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
@@ -85,15 +84,12 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
 def eval_tta(tta_step, params, batch_stats, batches, policy, mesh, key) -> dict:
     """Run the TTA step over a fold's batches; returns
     {'minus_loss', 'top1_valid'} normalized by sample count
-    (reference ``search.py:117-133``)."""
+    (reference ``search.py:117-133``).
+
+    `batches` yields per-process ``(images, labels, mask)`` shards as
+    produced by `eval_batches` (which owns padding + host sharding)."""
     acc = Accumulator()
-    for i, (images, labels) in enumerate(batches):
-        n = len(labels)
-        pad = (-n) % mesh.size
-        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-        if pad:
-            images = np.concatenate([images, np.repeat(images[-1:], pad, axis=0)])
-            labels = np.concatenate([labels, np.repeat(labels[-1:], pad, axis=0)])
+    for i, (images, labels, mask) in enumerate(batches):
         batch = shard_batch(mesh, {"x": images, "y": labels, "m": mask})
         out = tta_step(
             params, batch_stats, batch["x"], batch["y"], batch["m"], policy,

@@ -75,14 +75,11 @@ def resolve_policy_tensor(aug: Any):
 
 
 def _run_eval(eval_step, params, batch_stats, batches, mesh) -> dict:
+    """`batches` yields per-process (images, labels, mask) shards —
+    padding/sharding lives in `eval_batches` (one place, multi-host
+    aware), not here."""
     acc = Accumulator()
-    for images, labels in batches:
-        n = len(labels)
-        pad = (-n) % mesh.size
-        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-        if pad:
-            images = np.concatenate([images, np.repeat(images[-1:], pad, axis=0)])
-            labels = np.concatenate([labels, np.repeat(labels[-1:], pad, axis=0)])
+    for images, labels, mask in batches:
         batch = shard_batch(mesh, {"x": images, "y": labels, "m": mask})
         acc.add_dict(eval_step(params, batch_stats, batch["x"], batch["y"], batch["m"]))
     return acc.normalize()
@@ -129,7 +126,11 @@ def train_and_eval(
     is_imagenet = dataset_name.endswith("imagenet")
     from fast_autoaugment_tpu.models import input_image_size
 
-    image = input_image_size(dataset_name, conf["model"]["type"])
+    # conf['imgsize'] overrides the native resolution (the reference
+    # evaluates ResNet-200 at 320px, README.md:44-46)
+    image = int(conf.get("imgsize", 0) or 0) or input_image_size(
+        dataset_name, conf["model"]["type"]
+    )
     if is_imagenet:
         from fast_autoaugment_tpu.ops.preprocess_imagenet import (
             center_crop_box,
@@ -240,15 +241,20 @@ def train_and_eval(
             if len(it) == 0:
                 out[split] = {"loss": 0.0, "top1": 0.0, "top5": 0.0, "num": 0}
                 continue
+            eval_kw = dict(
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                pad_multiple=mesh.size,
+            )
             norm = _run_eval(
                 eval_step, state.params, state.batch_stats,
-                it.eval_epoch(global_batch), mesh,
+                it.eval_epoch(global_batch, **eval_kw), mesh,
             )
             out[split] = norm
             if state.ema is not None:
                 norm_ema = _run_eval(
                     eval_step, state.ema["params"], state.ema["batch_stats"],
-                    it.eval_epoch(global_batch), mesh,
+                    it.eval_epoch(global_batch, **eval_kw), mesh,
                 )
                 # with EMA on, the REPORTED valid/test numbers are the
                 # EMA model's (reference train.py:277-280 overwrites
