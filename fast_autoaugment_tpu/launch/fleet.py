@@ -9,9 +9,11 @@ is multi-controller, every host simply runs the SAME command with its
 What remains worth keeping from the reference's design is the process
 hygiene, provided here natively:
 
-- every remote command runs under ``setsid`` so the whole remote
-  process TREE dies with one signal (the reference's fork-middleman
-  trick, ``safe_shell_exec.py:29-60``);
+- every remote command stays attached to the ``ssh -tt`` pty as its
+  controlling terminal, so when the local ssh dies the kernel delivers
+  SIGHUP to the remote foreground process group and the tree dies with
+  it (the goal of the reference's fork-middleman + explicit
+  signal-forwarding machinery, ``safe_shell_exec.py:29-60``);
 - local SIGINT/SIGTERM (and normal exit) fan out kills to every host;
 - remote stdout/stderr is streamed line-by-line with a ``[host]``
   prefix (``safe_shell_exec.py:63-87``);
@@ -62,8 +64,9 @@ class _Fleet:
                 if p.poll() is None:
                     try:
                         # the local ssh runs in its own session; killing it
-                        # drops the connection, and the remote setsid group
-                        # dies with the controlling terminal
+                        # closes the remote pty, and the kernel HUPs the
+                        # remote foreground process group (the command tree
+                        # is deliberately NOT setsid-detached from the pty)
                         os.killpg(os.getpgid(p.pid), sig)
                     except (ProcessLookupError, PermissionError):
                         pass
@@ -100,9 +103,13 @@ def launch_fleet(hosts: list[str], command: list[str], coordinator: str | None,
         envs = " ".join(
             f"{k}={shlex.quote(os.environ[k])}" for k in env_passthrough if k in os.environ
         )
-        # setsid so the remote tree is one killable group; ssh -tt ties its
-        # lifetime to ours (safe_shell_exec.py:98-105 equivalent)
-        wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec setsid " + " ".join(
+        # NO setsid: the remote command must keep the ssh pty as its
+        # controlling terminal so pty teardown HUPs the whole foreground
+        # group — a setsid-detached tree would never see the hangup and
+        # Ctrl-C here would orphan remote training processes
+        # (safe_shell_exec.py:98-131 solves the same problem with an
+        # explicit signal-forwarding middleman)
+        wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec " + " ".join(
             shlex.quote(c) for c in remote_cmd
         )
         full = ["ssh", "-tt", "-o", "BatchMode=yes", host, wire]

@@ -14,7 +14,7 @@ Semantics were pinned against PIL empirically and are exact (see
 ``tests/test_augment_golden.py``):
 
 - affine/rotate: nearest-neighbor, ``src = floor(A @ (x, y) + t + 0.5)``,
-  fill 0, rotate about ``((W-1)/2, (H-1)/2)``  (PIL ``Image.transform``
+  fill 0, rotate about ``(W/2, H/2)``  (PIL ``Image.transform``
   with ``AFFINE`` / ``Image.rotate``, reference ``augmentations.py:17-62``)
 - L (grayscale): ``(r*19595 + g*38470 + b*7471 + 0x8000) >> 16``
 - enhance ops: ``clip(trunc(deg + (img - deg) * factor), 0, 255)`` in

@@ -1,0 +1,67 @@
+"""Trial/checkpoint pairing invariants of the multi-host merge tool.
+
+A fold's TPE rewards are only meaningful against the fold checkpoint
+they were computed with, so `tools/merge_trials.py` must never install
+a checkpoint whose fold's winning trials came from somewhere else —
+including the case where the pre-existing DESTINATION trials win a fold
+but the destination has no checkpoint file (ADVICE round 1, low).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+import merge_trials  # noqa: E402
+
+
+def _mkdir(base, name, trials=None, ckpts=()):
+    d = os.path.join(base, name)
+    os.makedirs(d, exist_ok=True)
+    if trials is not None:
+        with open(os.path.join(d, "search_trials.json"), "w") as fh:
+            json.dump(trials, fh)
+    for ckpt in ckpts:
+        with open(os.path.join(d, ckpt), "w") as fh:
+            fh.write(name)  # payload identifies the origin dir
+    return d
+
+
+def test_destination_winning_fold_blocks_source_checkpoint(tmp_path):
+    trial = [({"p": 0}, 0.5)]
+    dest = _mkdir(tmp_path, "dest", trials={"0": trial * 3})  # wins fold 0, no ckpt
+    src = _mkdir(tmp_path, "src", trials={"0": trial * 2},
+                 ckpts=["fold0_wresnet40_2.msgpack"])
+
+    merge_trials.main(["--into", dest, src])
+
+    # src lost fold 0 -> its checkpoint must NOT be installed
+    assert not os.path.exists(os.path.join(dest, "fold0_wresnet40_2.msgpack"))
+    with open(os.path.join(dest, "search_trials.json")) as fh:
+        assert len(json.load(fh)["0"]) == 3
+
+
+def test_winning_source_checkpoint_travels_with_its_trials(tmp_path):
+    trial = [({"p": 0}, 0.5)]
+    dest = _mkdir(tmp_path, "dest")
+    a = _mkdir(tmp_path, "a", trials={"1": trial * 5},
+               ckpts=["fold1_wresnet40_2.msgpack"])
+    b = _mkdir(tmp_path, "b", trials={"1": trial * 2},
+               ckpts=["fold1_wresnet40_2.msgpack"])
+
+    merge_trials.main(["--into", dest, b, a])
+
+    path = os.path.join(dest, "fold1_wresnet40_2.msgpack")
+    with open(path) as fh:
+        assert fh.read() == "a", "checkpoint must come from the winning host"
+    with open(os.path.join(dest, "search_trials.json")) as fh:
+        assert len(json.load(fh)["1"]) == 5
+
+
+def test_unclaimed_checkpoints_copy_if_missing(tmp_path):
+    dest = _mkdir(tmp_path, "dest")
+    src = _mkdir(tmp_path, "src", trials={},
+                 ckpts=["fold2_wresnet40_2.msgpack"])
+    merge_trials.main(["--into", dest, src])
+    assert os.path.exists(os.path.join(dest, "fold2_wresnet40_2.msgpack"))

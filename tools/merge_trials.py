@@ -33,8 +33,11 @@ def main(argv=None):
 
     # a fold's trial rewards were produced against THAT host's fold
     # checkpoint — trials and checkpoint must travel together, or resumed
-    # TPE runs would mix rewards from two differently-initialized models
-    fold_source: dict[str, str] = {}
+    # TPE runs would mix rewards from two differently-initialized models.
+    # Folds already held by the destination count as won by the
+    # destination: a source checkpoint must never be installed for them,
+    # even when the destination lacks its own checkpoint file.
+    fold_source: dict[str, str] = {fold: args.into for fold in merged}
     for src in args.sources:
         trials_path = os.path.join(src, "search_trials.json")
         if os.path.exists(trials_path):
