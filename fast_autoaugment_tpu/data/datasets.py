@@ -140,10 +140,34 @@ def _load_imagenet_listing(dataroot: str, split: str) -> ArrayDataset:
     paths, labels = [], []
     if os.path.exists(listfile):
         with open(listfile) as fh:
-            for line in fh:
-                rel, _idx, lb = line.split()
+            lines = [ln.split() for ln in fh if ln.strip()]
+        if lines and len(lines[0]) >= 3:
+            # extended 3-token form: <relpath> <index> <label>
+            for rel, _idx, lb in lines:
                 paths.append(os.path.join(root, rel))
                 labels.append(int(lb))
+        else:
+            # Kaggle CLS-LOC form (what the reference's train_cls.txt
+            # is, imagenet.py:60-88): <wnid>/<stem> <index>; label is
+            # the sorted-wnid rank, extensionless stems get .JPEG
+            rels = [ln[0] for ln in lines]
+            flat = [r for r in rels if "/" not in r]
+            if flat:
+                raise ValueError(
+                    f"{listfile}: {len(flat)} entries lack a '<wnid>/' "
+                    "directory prefix (e.g. a flat val listing) — labels "
+                    "cannot be derived; reorganize with "
+                    "tools/prepare_imagenet.py val-reorg and regenerate, "
+                    "or use the 3-token '<relpath> <index> <label>' form"
+                )
+            class_to_idx = {
+                w: i for i, w in enumerate(sorted({r.split("/")[0] for r in rels}))
+            }
+            for rel in rels:
+                if not os.path.splitext(rel)[1]:
+                    rel += ".JPEG"
+                paths.append(os.path.join(root, rel))
+                labels.append(class_to_idx[rel.split("/")[0]])
     else:
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
@@ -156,6 +180,40 @@ def _load_imagenet_listing(dataroot: str, split: str) -> ArrayDataset:
     return ArrayDataset(
         np.asarray(paths, object), np.asarray(labels, np.int32), 1000, lazy=True
     )
+
+
+def _synthetic_shapes(n_train: int = 600, n_test: int = 2000, size: int = 32):
+    """Structured 10-class glyph dataset for end-to-end search validation.
+
+    Each class is a fixed 12x12 binary glyph; every sample renders it at
+    a random position with random foreground/background intensity,
+    contrast and pixel noise.  Train is deliberately SMALL (60/class) so
+    an unaugmented model overfits and label-preserving augmentation
+    (translation, brightness/contrast, cutout — all in the search's op
+    vocabulary) measurably improves test accuracy.  Deterministic; i.i.d.
+    train/test, so any phase-3 gain is pure regularization, not a
+    distribution-shift trick.
+    """
+    glyph_rng = np.random.default_rng(7)
+    glyphs = (glyph_rng.uniform(size=(10, 12, 12)) < 0.45).astype(np.float32)
+
+    def render(n, seed):
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, 10, n).astype(np.int32)
+        images = np.empty((n, size, size, 3), np.uint8)
+        for i, lb in enumerate(labels):
+            bg = rng.uniform(30, 120)
+            fg = bg + rng.uniform(60, 130)
+            contrast = rng.uniform(0.7, 1.3)
+            canvas = np.full((size, size), bg, np.float32)
+            y, x = rng.integers(0, size - 12, 2)
+            canvas[y:y + 12, x:x + 12] += glyphs[lb] * (fg - bg)
+            canvas = (canvas - canvas.mean()) * contrast + canvas.mean()
+            canvas = canvas + rng.normal(0, 12, (size, size))
+            images[i] = np.clip(canvas, 0, 255)[..., None].astype(np.uint8)
+        return ArrayDataset(images, labels, 10)
+
+    return render(n_train, 1), render(n_test, 2)
 
 
 def _synthetic(num_classes: int, n_train: int = 512, n_test: int = 256, size: int = 32):
@@ -233,6 +291,9 @@ def load_dataset(dataset: str, dataroot: str):
         data = np.load(os.path.join(dataroot, "cifar10.1_v6_data.npy"))
         labels = np.load(os.path.join(dataroot, "cifar10.1_v6_labels.npy"))
         return train, ArrayDataset(data.astype(np.uint8), labels.astype(np.int32), 10)
+    if dataset == "synthetic_shapes":
+        # structured glyph task for end-to-end search validation
+        return _synthetic_shapes()
     if dataset.startswith("synthetic"):
         # synthetic / synthetic_cifar100-style names for tests and benches
         num_classes = 100 if dataset.endswith("100") else 10

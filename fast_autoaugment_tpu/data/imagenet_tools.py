@@ -1,0 +1,124 @@
+"""ImageNet preparation machinery: devkit parsing, val reorganization,
+listfile generation.
+
+The reference prepares ImageNet with torchvision-style helpers
+(reference ``imagenet.py:165-245``): parse the ILSVRC2012 devkit's
+``meta.mat`` for the synset table, read the val ground-truth index
+list, and physically reorganize the flat ``val/`` download into
+per-wnid class folders so the plain ImageFolder reader applies.  This
+module supplies the same capabilities for local trees.  The tar
+*download* machinery (``imagenet.py:180-192``) is deliberately absent:
+this build environment is zero-egress, and the framework consumes
+already-extracted trees (documented deviation, docs/PARITY.md).
+
+A listfile *generator* is added (the reference only consumes
+``train_cls.txt``, it never ships one): it emits the Kaggle CLS-LOC
+format the reference parses — ``<wnid>/<stem> <1-based index>`` per
+line, extension stripped (reference ``imagenet.py:60-88``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+__all__ = [
+    "parse_meta_mat",
+    "parse_val_groundtruth",
+    "parse_devkit",
+    "prepare_val_folder",
+    "write_listfile",
+]
+
+IMG_EXTENSIONS = (".jpeg", ".jpg", ".png", ".bmp", ".webp")
+
+
+def parse_meta_mat(devkit_root: str):
+    """Parse ``<devkit>/data/meta.mat`` -> (idx_to_wnid, wnid_to_classes).
+
+    Keeps only LEAF synsets (``num_children == 0``) — the 1000
+    classification classes; internal WordNet nodes are dropped
+    (reference ``imagenet.py:201-214``).  Class names are tuples of the
+    comma-separated "words" field.
+    """
+    import scipy.io as sio
+
+    meta = sio.loadmat(
+        os.path.join(devkit_root, "data", "meta.mat"), squeeze_me=True
+    )["synsets"]
+    leaves = meta[meta["num_children"] == 0]
+    idx_to_wnid = {}
+    wnid_to_classes = {}
+    for rec in leaves:
+        idx = int(rec["ILSVRC2012_ID"])
+        wnid = str(rec["WNID"])
+        classes = tuple(str(rec["words"]).split(", "))
+        idx_to_wnid[idx] = wnid
+        wnid_to_classes[wnid] = classes
+    return idx_to_wnid, wnid_to_classes
+
+
+def parse_val_groundtruth(devkit_root: str) -> list[int]:
+    """``ILSVRC2012_validation_ground_truth.txt`` -> 1-based synset ids,
+    one per val image in sorted-filename order (reference
+    ``imagenet.py:217-221``)."""
+    path = os.path.join(
+        devkit_root, "data", "ILSVRC2012_validation_ground_truth.txt"
+    )
+    with open(path) as fh:
+        return [int(line) for line in fh if line.strip()]
+
+
+def parse_devkit(devkit_root: str):
+    """-> (wnid_to_classes, val_wnids): per-val-image wnid labels in
+    sorted-filename order (reference ``imagenet.py:194-198``)."""
+    idx_to_wnid, wnid_to_classes = parse_meta_mat(devkit_root)
+    val_wnids = [idx_to_wnid[idx] for idx in parse_val_groundtruth(devkit_root)]
+    return wnid_to_classes, val_wnids
+
+
+def prepare_val_folder(val_dir: str, devkit_root: str) -> int:
+    """Move the flat ``val/`` images into per-wnid class folders
+    (reference ``imagenet.py:233-240``); returns #images moved.
+
+    Sorted filename order pairs image i with ground-truth line i.
+    Idempotent: already-organized trees (no loose files) are a no-op.
+    """
+    _, val_wnids = parse_devkit(devkit_root)
+    img_files = sorted(
+        f for f in os.listdir(val_dir)
+        if os.path.isfile(os.path.join(val_dir, f))
+    )
+    if not img_files:
+        return 0
+    if len(img_files) != len(val_wnids):
+        raise ValueError(
+            f"{len(img_files)} loose val images but {len(val_wnids)} "
+            "ground-truth labels — refusing to mispair"
+        )
+    for wnid in set(val_wnids):
+        os.makedirs(os.path.join(val_dir, wnid), exist_ok=True)
+    for wnid, name in zip(val_wnids, img_files):
+        shutil.move(os.path.join(val_dir, name), os.path.join(val_dir, wnid, name))
+    return len(img_files)
+
+
+def write_listfile(split_dir: str, out_path: str) -> int:
+    """Generate a CLS-LOC-format listfile for an ImageFolder tree:
+    ``<wnid>/<stem> <1-based index>`` per image, classes and files in
+    sorted order (the format reference ``imagenet.py:60-88`` consumes to
+    skip its os.walk).  Returns #lines written."""
+    wnids = sorted(
+        d for d in os.listdir(split_dir)
+        if os.path.isdir(os.path.join(split_dir, d))
+    )
+    n = 0
+    with open(out_path, "w") as fh:
+        for wnid in wnids:
+            for name in sorted(os.listdir(os.path.join(split_dir, wnid))):
+                stem, ext = os.path.splitext(name)
+                if ext.lower() not in IMG_EXTENSIONS:
+                    continue
+                n += 1
+                fh.write(f"{wnid}/{stem} {n}\n")
+    return n

@@ -1,0 +1,133 @@
+"""Devkit parsing + val reorganization + listfile generation on a
+fabricated mini ILSVRC2012 tree (reference ``imagenet.py:165-245``
+capabilities; VERDICT round 1, missing item 3)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.data.imagenet_tools import (
+    parse_devkit,
+    parse_meta_mat,
+    prepare_val_folder,
+    write_listfile,
+)
+
+WNIDS = ["n01440764", "n01443537", "n02084071"]
+
+
+def _write_devkit(root, n_val=6):
+    """Fabricate devkit/data/{meta.mat, ground truth} with 3 leaf synsets
+    and one internal node (num_children > 0, must be dropped)."""
+    import scipy.io
+
+    data_dir = os.path.join(root, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    synsets = np.array(
+        [
+            (1, WNIDS[0], "tench, Tinca tinca", "a fish", 0),
+            (2, WNIDS[1], "goldfish", "a fish", 0),
+            (3, WNIDS[2], "dog", "an animal", 0),
+            (4, "n00001740", "entity", "internal node", 3),
+        ],
+        dtype=[
+            ("ILSVRC2012_ID", "i4"), ("WNID", "O"), ("words", "O"),
+            ("gloss", "O"), ("num_children", "i4"),
+        ],
+    )
+    scipy.io.savemat(os.path.join(data_dir, "meta.mat"), {"synsets": synsets})
+    # val image i (sorted order) belongs to synset id gt[i]
+    gt = [(i % 3) + 1 for i in range(n_val)]
+    with open(
+        os.path.join(data_dir, "ILSVRC2012_validation_ground_truth.txt"), "w"
+    ) as fh:
+        fh.writelines(f"{g}\n" for g in gt)
+    return gt
+
+
+def _write_flat_val(root, n_val=6):
+    os.makedirs(root, exist_ok=True)
+    names = [f"ILSVRC2012_val_{i:08d}.JPEG" for i in range(1, n_val + 1)]
+    for name in names:
+        with open(os.path.join(root, name), "w") as fh:
+            fh.write(name)
+    return names
+
+
+def test_parse_meta_drops_internal_nodes(tmp_path):
+    _write_devkit(str(tmp_path))
+    idx_to_wnid, wnid_to_classes = parse_meta_mat(str(tmp_path))
+    assert idx_to_wnid == {1: WNIDS[0], 2: WNIDS[1], 3: WNIDS[2]}
+    assert "n00001740" not in wnid_to_classes
+    assert wnid_to_classes[WNIDS[0]] == ("tench", "Tinca tinca")
+
+
+def test_val_reorg_pairs_sorted_files_with_groundtruth(tmp_path):
+    devkit = tmp_path / "devkit"
+    val = tmp_path / "val"
+    gt = _write_devkit(str(devkit))
+    names = _write_flat_val(str(val))
+
+    moved = prepare_val_folder(str(val), str(devkit))
+    assert moved == len(names)
+    for i, name in enumerate(names):
+        wnid = WNIDS[gt[i] - 1]
+        assert os.path.exists(os.path.join(str(val), wnid, name))
+    # idempotent: second run moves nothing
+    assert prepare_val_folder(str(val), str(devkit)) == 0
+
+
+def test_val_reorg_refuses_count_mismatch(tmp_path):
+    devkit = tmp_path / "devkit"
+    val = tmp_path / "val"
+    _write_devkit(str(devkit), n_val=6)
+    _write_flat_val(str(val), n_val=5)
+    with pytest.raises(ValueError, match="refusing to mispair"):
+        prepare_val_folder(str(val), str(devkit))
+
+
+def test_listfile_roundtrip_through_dataset_reader(tmp_path):
+    """Generated CLS-LOC listfile (2-token, extensionless) must load back
+    through `_load_imagenet_listing` with identical paths/labels as the
+    os.walk path."""
+    from fast_autoaugment_tpu.data.datasets import _load_imagenet_listing
+
+    root = tmp_path / "train"
+    for wnid in WNIDS:
+        os.makedirs(root / wnid)
+        for j in range(2):
+            with open(root / wnid / f"{wnid}_{j}.JPEG", "w") as fh:
+                fh.write("x")
+
+    walk = _load_imagenet_listing(str(tmp_path), "train")
+
+    out = tmp_path / "train_cls.txt"
+    n = write_listfile(str(root), str(out))
+    assert n == 6
+    with open(out) as fh:
+        first = fh.readline().split()
+    assert len(first) == 2 and "/" in first[0] and "." not in first[0]
+
+    listed = _load_imagenet_listing(str(tmp_path), "train")
+    assert list(listed.images) == list(walk.images)
+    assert listed.labels.tolist() == walk.labels.tolist()
+
+
+def test_devkit_cli(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import prepare_imagenet
+
+    devkit = tmp_path / "devkit"
+    _write_devkit(str(devkit))
+    val = tmp_path / "imagenet" / "val"
+    _write_flat_val(str(val))
+    prepare_imagenet.main(["val-reorg", "--root", str(tmp_path / "imagenet"),
+                           "--devkit", str(devkit)])
+    prepare_imagenet.main(["listfile", "--root", str(tmp_path / "imagenet"),
+                           "--split", "val"])
+    assert os.path.exists(tmp_path / "imagenet" / "val_cls.txt")
+    out = capsys.readouterr().out
+    assert "moved 6" in out and "wrote 6 entries" in out

@@ -1,0 +1,63 @@
+"""Prepare a local ILSVRC2012 tree for the framework.
+
+Subcommands (composable; reference ``imagenet.py:165-245`` capabilities,
+minus download — zero-egress deviation documented in docs/PARITY.md):
+
+  val-reorg:  move the flat ``val/`` images into per-wnid folders using
+              the devkit's meta.mat + ground-truth list
+  listfile:   generate ``train_cls.txt`` / ``val_cls.txt`` (CLS-LOC
+              format) so dataset loading skips the os.walk
+  meta:       print the parsed synset table (sanity check)
+
+    python tools/prepare_imagenet.py val-reorg --root /data/imagenet \
+        --devkit /data/ILSVRC2012_devkit_t12
+    python tools/prepare_imagenet.py listfile --root /data/imagenet --split train
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fast_autoaugment_tpu.data.imagenet_tools import (  # noqa: E402
+    parse_devkit,
+    prepare_val_folder,
+    write_listfile,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pv = sub.add_parser("val-reorg", help="flat val/ -> per-wnid folders")
+    pv.add_argument("--root", required=True, help="imagenet root (contains val/)")
+    pv.add_argument("--devkit", required=True, help="ILSVRC2012_devkit_t12 dir")
+
+    pl = sub.add_parser("listfile", help="generate <split>_cls.txt")
+    pl.add_argument("--root", required=True)
+    pl.add_argument("--split", default="train", choices=["train", "val"])
+
+    pm = sub.add_parser("meta", help="print parsed devkit synsets")
+    pm.add_argument("--devkit", required=True)
+
+    args = p.parse_args(argv)
+    if args.cmd == "val-reorg":
+        n = prepare_val_folder(os.path.join(args.root, "val"), args.devkit)
+        print(f"moved {n} val images into wnid folders")
+    elif args.cmd == "listfile":
+        out = os.path.join(args.root, f"{args.split}_cls.txt")
+        n = write_listfile(os.path.join(args.root, args.split), out)
+        print(f"wrote {n} entries to {out}")
+    else:
+        wnid_to_classes, val_wnids = parse_devkit(args.devkit)
+        print(f"{len(wnid_to_classes)} leaf synsets, {len(val_wnids)} val labels")
+        for wnid, classes in sorted(wnid_to_classes.items())[:5]:
+            print(f"  {wnid}: {', '.join(classes)}")
+
+
+if __name__ == "__main__":
+    main()
