@@ -1,0 +1,161 @@
+"""Reproduce the reference's published-checkpoint numbers.
+
+The reference README publishes 13 trained checkpoints with their test
+top-1 errors (reference ``README.md:20-52``; machine-readable bracket in
+``BASELINE.md``).  This tool holds that table as a MANIFEST — published
+filename -> (model conf, dataset, expected top-1 error %) — and, for
+every manifest file present under ``--ckpt-dir``, runs the full
+import + ``--only-eval`` pipeline and compares the measured error
+against the published number:
+
+    python tools/reproduce_checkpoints.py --ckpt-dir /ckpts \
+        --dataroot /data --report docs/repro_report.md
+
+Files that are absent are skipped (the build environment is zero-egress;
+drop whatever .pth files you have into --ckpt-dir).  Exit code is 1 if
+any evaluated checkpoint misses its expected error by more than --tol
+percentage points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# name -> (model conf, dataset, expected top-1 error %, imgsize override)
+# expected = the published checkpoint's own error where the filename
+# records one, else the paper's direct-search number (README.md:20-52)
+MANIFEST: dict[str, dict] = {
+    "cifar10_wresnet40x2_top1_3.52.pth": {
+        "model": {"type": "wresnet40_2"}, "dataset": "cifar10", "expected": 3.52},
+    "cifar10_wresnet28x10_top1.pth": {
+        "model": {"type": "wresnet28_10"}, "dataset": "cifar10", "expected": 2.7},
+    "cifar10_shake26_2x32d_top1_2.68.pth": {
+        "model": {"type": "shakeshake26_2x32d"}, "dataset": "cifar10", "expected": 2.68},
+    "cifar10_shake26_2x96d_top1_1.97.pth": {
+        "model": {"type": "shakeshake26_2x96d"}, "dataset": "cifar10", "expected": 1.97},
+    "cifar10_shake26_2x112d_top1_2.04.pth": {
+        "model": {"type": "shakeshake26_2x112d"}, "dataset": "cifar10", "expected": 2.04},
+    "cifar10_pyramid272_top1_1.44.pth": {
+        "model": {"type": "pyramid", "depth": 272, "alpha": 200, "bottleneck": True},
+        "dataset": "cifar10", "expected": 1.44},
+    "cifar100_wresnet40x2_top1_20.43.pth": {
+        "model": {"type": "wresnet40_2"}, "dataset": "cifar100", "expected": 20.43},
+    "cifar100_wresnet28x10_top1_17.17.pth": {
+        "model": {"type": "wresnet28_10"}, "dataset": "cifar100", "expected": 17.17},
+    "cifar100_shake26_2x96d_top1_15.15.pth": {
+        "model": {"type": "shakeshake26_2x96d"}, "dataset": "cifar100", "expected": 15.15},
+    "cifar100_pyramid272_top1_11.74.pth": {
+        "model": {"type": "pyramid", "depth": 272, "alpha": 200, "bottleneck": True},
+        "dataset": "cifar100", "expected": 11.74},
+    "imagenet_resnet50_top1_22.2.pth": {
+        "model": {"type": "resnet50"}, "dataset": "imagenet", "expected": 22.2},
+    "imagenet_resnet200_top1_19.4.pth": {
+        "model": {"type": "resnet200"}, "dataset": "imagenet", "expected": 19.4,
+        "imgsize": 320},
+    "imagenet_resnet200_res224.pth": {
+        "model": {"type": "resnet200"}, "dataset": "imagenet", "expected": 20.0},
+}
+
+
+def evaluate_checkpoint(pth: str, entry: dict, dataroot: str, work_dir: str,
+                        batch: int = 64) -> dict:
+    """Import one .pth and run --only-eval; returns the result row."""
+    from import_checkpoint import main as import_main
+
+    from fast_autoaugment_tpu.core.config import Config
+    from fast_autoaugment_tpu.train.trainer import train_and_eval
+
+    model_conf = dict(entry["model"])
+    out = os.path.join(
+        work_dir, os.path.basename(pth).replace(".pth", ".msgpack"))
+    import_args = ["--pth", pth, "--model", model_conf["type"],
+                   "--dataset", entry["dataset"], "--out", out]
+    import_main(import_args)
+
+    conf = Config({
+        "model": model_conf,
+        "dataset": entry["dataset"],
+        "aug": "default",
+        "batch": batch,
+        "epoch": 1,
+        "lr": 0.1,
+        "lr_schedule": {"type": "cosine", "warmup": {"multiplier": 1, "epoch": 0}},
+        "optimizer": {"type": "sgd", "decay": 0.0, "momentum": 0.9,
+                      "nesterov": True},
+        **({"imgsize": entry["imgsize"]} if "imgsize" in entry else {}),
+    })
+    result = train_and_eval(conf, dataroot, save_path=out, only_eval=True,
+                            metric="last")
+    err = (1.0 - float(result["top1_test"])) * 100.0
+    return {
+        "file": os.path.basename(pth),
+        "model": model_conf["type"],
+        "dataset": entry["dataset"],
+        "expected_err": entry["expected"],
+        "measured_err": round(err, 2),
+        "delta": round(err - entry["expected"], 2),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--dataroot", required=True)
+    p.add_argument("--work-dir", default=None,
+                   help="where imported .msgpack files go (default: ckpt-dir)")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--tol", type=float, default=0.2,
+                   help="allowed |measured - expected| in percentage points")
+    p.add_argument("--report", default=None, help="markdown report path")
+    args = p.parse_args(argv)
+
+    work = args.work_dir or args.ckpt_dir
+    os.makedirs(work, exist_ok=True)
+    rows, missing = [], []
+    for name, entry in MANIFEST.items():
+        pth = os.path.join(args.ckpt_dir, name)
+        if not os.path.exists(pth):
+            missing.append(name)
+            continue
+        print(f"== {name}", flush=True)
+        rows.append(evaluate_checkpoint(pth, entry, args.dataroot, work,
+                                        batch=args.batch))
+        print(json.dumps(rows[-1]), flush=True)
+
+    lines = [
+        "| checkpoint | model | dataset | expected err% | measured err% | delta |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['file']} | {r['model']} | {r['dataset']} | "
+            f"{r['expected_err']} | {r['measured_err']} | {r['delta']:+.2f} |"
+        )
+    table = "\n".join(lines)
+    print(table)
+    if missing:
+        print(f"({len(missing)} manifest checkpoints not present, skipped)")
+    if args.report:
+        with open(args.report, "w") as fh:
+            fh.write(
+                "# Published-checkpoint reproduction\n\n"
+                "Reference README download table vs this framework's "
+                "import + `--only-eval` (reference ``README.md:20-52``).\n\n"
+                + table + "\n\n"
+                + (f"Skipped (not on disk): {', '.join(missing)}\n" if missing else "")
+            )
+
+    bad = [r for r in rows if abs(r["delta"]) > args.tol]
+    if bad:
+        print(f"FAIL: {len(bad)} checkpoint(s) outside ±{args.tol}pp")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
