@@ -1,0 +1,162 @@
+"""Host-feed throughput benchmark: native C++ loader vs PIL, and the
+prefetch-depth sweep (VERDICT round 1, next-step 6).
+
+Measures, on a directory of real JPEGs (generated on the fly if absent):
+
+1. decode+crop+resize images/sec — native libjpeg thread-pool loader
+   (``native/faa_loader.cpp``) vs the PIL fallback, batch after batch;
+2. end-to-end `train_batches` + `prefetch(depth)` feed rate at several
+   depths — the rate at which the host can actually hand batches to the
+   device layer (reference baseline: 8 torch DataLoader workers per GPU,
+   reference ``data.py:214-224``).
+
+    python tools/bench_loader.py --n 512 --size 320 --target 224 \
+        --report docs/loader_bench.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_jpegs(root: str, n: int, size: int) -> list[str]:
+    """Synthesize photographic-ish JPEGs (smooth gradients + texture so
+    entropy, and thus decode cost, is realistic)."""
+    import PIL.Image
+
+    os.makedirs(root, exist_ok=True)
+    paths = []
+    rng = np.random.default_rng(0)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    for i in range(n):
+        base = np.stack([
+            127 + 120 * np.sin(2 * np.pi * (xx * rng.uniform(1, 3) + rng.uniform())),
+            127 + 120 * np.cos(2 * np.pi * (yy * rng.uniform(1, 3) + rng.uniform())),
+            127 + 120 * np.sin(2 * np.pi * ((xx + yy) * rng.uniform(1, 2))),
+        ], axis=-1)
+        noise = rng.normal(0, 20, (size, size, 3))
+        img = np.clip(base + noise, 0, 255).astype(np.uint8)
+        p = os.path.join(root, f"img_{i:05d}.jpg")
+        PIL.Image.fromarray(img).save(p, quality=90)
+        paths.append(p)
+    return paths
+
+
+def bench_decoder(paths, target: int, batch: int, use_native: bool) -> float:
+    """images/sec for full-frame decode+resize over all paths."""
+    from fast_autoaugment_tpu.data import native_loader
+
+    boxes = None  # full-frame
+    t0 = time.perf_counter()
+    n = 0
+    for s in range(0, len(paths), batch):
+        chunk = paths[s:s + batch]
+        if use_native:
+            full = np.array(
+                [[0, 0, w, h] for w, h in
+                 (native_loader.image_size(p) for p in chunk)], np.float32)
+            out, failures = native_loader.decode_resize_batch(chunk, target, full)
+            assert failures == 0
+        else:
+            import PIL.Image
+
+            out = np.stack([
+                np.asarray(
+                    PIL.Image.open(p).convert("RGB")
+                    .resize((target, target), PIL.Image.BICUBIC), np.uint8)
+                for p in chunk
+            ])
+        n += len(chunk)
+    return n / (time.perf_counter() - t0)
+
+
+def bench_feed(paths, target: int, batch: int, depth: int, steps: int) -> float:
+    """images/sec of the full train feed path (lazy dataset -> boxed
+    decode -> prefetch queue), consumed as fast as possible."""
+    from fast_autoaugment_tpu.data.datasets import ArrayDataset
+    from fast_autoaugment_tpu.data.pipeline import SizeCache, prefetch, train_batches
+
+    ds = ArrayDataset(np.asarray(paths, object),
+                      np.zeros(len(paths), np.int32), 10, lazy=True)
+    box = lambda rng, w, h: (0, 0, w, h)  # noqa: E731
+    cache = SizeCache()
+    it = prefetch(
+        train_batches(ds, None, batch, epoch=1, box_fn=box, imgsize=target,
+                      size_cache=cache),
+        depth=depth,
+    )
+    n = 0
+    t0 = time.perf_counter()
+    for images, _labels in it:
+        n += len(images)
+        if n >= steps * batch:
+            break
+    return n / (time.perf_counter() - t0)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dir", default="/tmp/faa_loader_bench")
+    p.add_argument("--n", type=int, default=512)
+    p.add_argument("--size", type=int, default=320, help="source JPEG side")
+    p.add_argument("--target", type=int, default=224)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--depths", default="1,2,4,8")
+    p.add_argument("--report", default=None)
+    args = p.parse_args(argv)
+
+    from fast_autoaugment_tpu.data import native_loader
+
+    existing = sorted(
+        os.path.join(args.dir, f) for f in os.listdir(args.dir)
+        if f.endswith(".jpg")
+    ) if os.path.isdir(args.dir) else []
+    paths = existing if len(existing) >= args.n else make_jpegs(
+        args.dir, args.n, args.size)
+
+    rows = {}
+    rows["pil"] = bench_decoder(paths, args.target, args.batch, use_native=False)
+    print(f"PIL decode+resize:    {rows['pil']:8.1f} img/s")
+    if native_loader.available():
+        rows["native"] = bench_decoder(paths, args.target, args.batch, use_native=True)
+        print(f"native decode+resize: {rows['native']:8.1f} img/s "
+              f"({rows['native'] / rows['pil']:.1f}x PIL)")
+    else:
+        print("native loader not built (make -C native)")
+
+    depth_rows = {}
+    steps = max(2, len(paths) // args.batch - 1)
+    for depth in [int(d) for d in args.depths.split(",")]:
+        r = bench_feed(paths, args.target, args.batch, depth, steps)
+        depth_rows[depth] = r
+        print(f"feed depth={depth}:  {r:8.1f} img/s")
+
+    if args.report:
+        with open(args.report, "w") as fh:
+            fh.write(
+                "# Host-feed throughput\n\n"
+                f"{args.n} JPEGs {args.size}px -> {args.target}px, batch "
+                f"{args.batch} (this machine; see docs/BENCHMARKS.md for "
+                "context).\n\n"
+                "| path | img/s |\n|---|---|\n"
+                + f"| PIL decode+resize | {rows['pil']:.1f} |\n"
+                + (f"| native decode+resize | {rows['native']:.1f} |\n"
+                   if "native" in rows else "")
+                + "".join(
+                    f"| feed (prefetch depth {d}) | {r:.1f} |\n"
+                    for d, r in depth_rows.items()
+                )
+            )
+        print(f"wrote {args.report}")
+    return rows, depth_rows
+
+
+if __name__ == "__main__":
+    main()
