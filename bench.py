@@ -28,6 +28,7 @@ Two throughput numbers are measured:
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -89,7 +90,55 @@ def _step_flops(lowered_compiled) -> float | None:
         return None
 
 
+def _ensure_live_backend(reexec_argv=None, fallback_env=None):
+    """Fall back to a clean CPU env when the TPU tunnel is dead.
+
+    The ambient sitecustomize registers a single-chip TPU PJRT plugin in
+    every interpreter (gated on PALLAS_AXON_POOL_IPS); when the tunnel
+    drops, backend discovery hangs forever — even `jax.devices()` under
+    JAX_PLATFORMS=cpu.  Both failure modes are observed (round 1: claim
+    serialization; round 2: mid-round tunnel drop), so probe device init
+    in a throwaway subprocess first and, if it wedges, re-exec the
+    calling script (`reexec_argv`, default this bench) into a stripped
+    CPU environment with an explicit marker so the reported JSON can
+    never masquerade as a TPU number.  Shared by the sibling benchmark
+    tools (e.g. tools/bench_models.py), which pass their own argv and
+    fallback knobs.
+    """
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return  # nothing registered that could hang
+    probe_timeout = float(os.environ.get("FAA_BENCH_PROBE_TIMEOUT", 240))
+    if probe_timeout <= 0:
+        return  # probe disabled: trust the chip, skip the extra init
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout, capture_output=True,
+        ).returncode
+    except subprocess.TimeoutExpired:
+        rc = -1
+    if rc == 0:
+        return  # chip reachable; run the real benchmark
+    _log(f"TPU backend probe failed (rc={rc}); re-exec on clean CPU env")
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FAA_BENCH_CPU_FALLBACK"] = "1"
+    for k, v in (fallback_env or {}).items():
+        env.setdefault(k, v)
+    if reexec_argv is None:
+        reexec_argv = [sys.executable, os.path.abspath(__file__)]
+    os.execvpe(reexec_argv[0], reexec_argv, env)
+
+
 def main():
+    _ensure_live_backend(
+        # plumbing heartbeat only — keep the CPU run small
+        fallback_env={
+            "FAA_BENCH_BATCH": "32",
+            "FAA_BENCH_STEPS": "3",
+            "FAA_BENCH_WARMUP": "1",
+        },
+    )
     import jax
     import jax.numpy as jnp
 
@@ -188,20 +237,23 @@ def main():
     dt_hf = time.perf_counter() - t0
     hostfeed = hf_steps * global_batch / dt_hf / n_dev if hf_steps else None
 
-    print(
-        json.dumps(
-            {
-                "metric": "wrn40x2_cifar10_train_images_per_sec_per_chip",
-                "value": round(images_per_sec_per_chip, 1),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(images_per_sec_per_chip / REFERENCE_IMAGES_PER_SEC, 3),
-                "mfu": mfu,
-                "images_per_sec_hostfeed": round(hostfeed, 1) if hostfeed else None,
-                "batch_per_device": BATCH_PER_DEVICE,
-                "devices": n_dev,
-            }
+    out = {
+        "metric": "wrn40x2_cifar10_train_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec_per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+        "mfu": mfu,
+        "images_per_sec_hostfeed": round(hostfeed, 1) if hostfeed else None,
+        "batch_per_device": BATCH_PER_DEVICE,
+        "devices": n_dev,
+    }
+    if os.environ.get("FAA_BENCH_CPU_FALLBACK"):
+        out["backend"] = "cpu-fallback"
+        out["note"] = (
+            "TPU tunnel unreachable at bench time; this is a CPU plumbing "
+            "number. See docs/BENCHMARKS.md for the measured TPU result."
         )
-    )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
