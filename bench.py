@@ -218,17 +218,18 @@ def main():
         host_rng.integers(0, 256, (n_examples, 32, 32, 3), dtype=np.uint8),
         host_rng.integers(0, 10, (n_examples,), dtype=np.int32), 10,
     )
+    from fast_autoaugment_tpu.parallel.mesh import shard_transform
+
     it = prefetch(
-        train_batches(ds, None, global_batch, epoch=1), depth=PREFETCH_DEPTH
+        train_batches(ds, None, global_batch, epoch=1), depth=PREFETCH_DEPTH,
+        transform=shard_transform(mesh),
     )
-    images_h, labels_h = next(it)  # warm the pipeline + any reshape paths
-    b = shard_batch(mesh, {"x": images_h, "y": labels_h})
+    b = next(it)  # warm the pipeline + any reshape paths
     state, _ = step_exec(state, b["x"], b["y"], policy, rng)
     jax.block_until_ready(state.params)
     t0 = time.perf_counter()
     hf_steps = 0
-    for images_h, labels_h in it:
-        b = shard_batch(mesh, {"x": images_h, "y": labels_h})
+    for b in it:
         state, _ = step_exec(state, b["x"], b["y"], policy, rng)
         hf_steps += 1
         if hf_steps >= MEASURE_STEPS:

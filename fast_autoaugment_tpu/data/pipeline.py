@@ -200,9 +200,16 @@ def num_train_steps(n_examples: int, global_batch: int) -> int:
     return n_examples // global_batch
 
 
-def prefetch(iterator, depth: int = 2):
+def prefetch(iterator, depth: int = 2, transform=None):
     """Run `iterator` in a background thread with a bounded queue —
-    double-buffered host -> device feed."""
+    double-buffered host -> device feed.
+
+    `transform(item)` runs in the WORKER thread; passing the mesh's
+    `shard_batch` here starts the host->device copy off the consumer's
+    critical path, so the transfer overlaps the current step's device
+    work instead of serializing with step dispatch (JAX dispatch is
+    thread-safe; the copy lands on the same device stream either way).
+    """
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
     err: list[BaseException] = []
@@ -210,7 +217,7 @@ def prefetch(iterator, depth: int = 2):
     def worker():
         try:
             for item in iterator:
-                q.put(item)
+                q.put(item if transform is None else transform(item))
         except BaseException as e:  # propagate into the consumer
             err.append(e)
         finally:

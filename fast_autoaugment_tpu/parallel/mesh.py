@@ -34,6 +34,7 @@ __all__ = [
     "data_sharding",
     "replicated",
     "shard_batch",
+    "shard_transform",
     "distributed_init",
     "local_batch_to_global",
 ]
@@ -77,6 +78,16 @@ def shard_batch(mesh: Mesh, batch, axis_name: str = "data"):
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree.map(put, batch)
+
+
+def shard_transform(mesh: Mesh, keys=("x", "y"), axis_name: str = "data"):
+    """`transform=` hook for `data.pipeline.prefetch`: maps a pipeline
+    tuple to a `shard_batch`-placed dict in the prefetch worker thread,
+    so the H2D copy overlaps the in-flight step's device work."""
+    def transform(item):
+        return shard_batch(mesh, dict(zip(keys, item, strict=True)), axis_name)
+
+    return transform
 
 
 def local_batch_to_global(batch_per_device: int, mesh: Mesh) -> int:

@@ -235,6 +235,29 @@ def search_policies(
             eval_box_fn=box_fn, train_box_fn=box_fn, imgsize=image,
         )
 
+        def _fold_batches():
+            return fold_it.eval_epoch(
+                batch, process_index=jax.process_index(),
+                process_count=jax.process_count(), pad_multiple=mesh.size,
+            )
+
+        # in-memory datasets: upload the fold ONCE and replay the
+        # device-resident batches for all `num_search` trials (the data
+        # never changes between TPE samples — only the policy tensor
+        # does; saves num_search x (host slice + H2D) per fold).  Lazy
+        # on-disk datasets (ImageNet) keep the streaming path.
+        from fast_autoaugment_tpu.parallel.mesh import shard_transform
+
+        _to_device = shard_transform(mesh, ("x", "y", "m"))
+        if not total_train.lazy:
+            cached = [_to_device(t) for t in _fold_batches()]
+            _fold_batches = lambda: iter(cached)  # noqa: E731
+        else:
+            from fast_autoaugment_tpu.data.pipeline import prefetch
+
+            _stream = _fold_batches
+            _fold_batches = lambda: prefetch(_stream(), transform=_to_device)  # noqa: E731
+
         tpe = TPE(space, seed=seed * 1000 + fold)
         key_fold = jax.random.PRNGKey(seed * 77 + fold)
         fold_trials = trials_log.get(str(fold), [])
@@ -247,12 +270,8 @@ def search_policies(
             policies = policy_decoder(proposal, num_policy, num_op)
             policy_t = jnp.asarray(policy_to_tensor(policies))
             metrics = eval_tta(
-                tta_step, params, batch_stats,
-                fold_it.eval_epoch(
-                    batch, process_index=jax.process_index(),
-                    process_count=jax.process_count(), pad_multiple=mesh.size,
-                ),
-                policy_t, mesh, jax.random.fold_in(key_fold, trial_idx),
+                tta_step, params, batch_stats, _fold_batches(),
+                policy_t, jax.random.fold_in(key_fold, trial_idx),
             )
             tpe.tell(proposal, metrics["top1_valid"])
             fold_trials.append((proposal, metrics["top1_valid"]))

@@ -30,7 +30,6 @@ import jax.numpy as jnp
 
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
-from fast_autoaugment_tpu.parallel.mesh import shard_batch
 
 __all__ = ["make_tta_step", "eval_tta"]
 
@@ -81,16 +80,19 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
     return tta_step
 
 
-def eval_tta(tta_step, params, batch_stats, batches, policy, mesh, key) -> dict:
+def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
     """Run the TTA step over a fold's batches; returns
     {'minus_loss', 'top1_valid'} normalized by sample count
     (reference ``search.py:117-133``).
 
-    `batches` yields per-process ``(images, labels, mask)`` shards as
-    produced by `eval_batches` (which owns padding + host sharding)."""
+    `batches` yields mesh-placed ``{"x", "y", "m"}`` dicts
+    (`parallel.mesh.shard_transform` maps `eval_batches` tuples to
+    this shape) — the driver uploads each fold ONCE and replays the
+    device-resident batches across all trials (the fold data is
+    identical for every TPE sample; only the policy tensor changes),
+    or streams them through a prefetch worker for lazy datasets."""
     acc = Accumulator()
-    for i, (images, labels, mask) in enumerate(batches):
-        batch = shard_batch(mesh, {"x": images, "y": labels, "m": mask})
+    for i, batch in enumerate(batches):
         out = tta_step(
             params, batch_stats, batch["x"], batch["y"], batch["m"], policy,
             jax.random.fold_in(key, i),

@@ -37,7 +37,7 @@ from fast_autoaugment_tpu.data.pipeline import BatchIterator, prefetch
 from fast_autoaugment_tpu.models import get_model, num_class
 from fast_autoaugment_tpu.ops.optim import build_optimizer
 from fast_autoaugment_tpu.ops.schedules import build_schedule
-from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_batch
+from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_transform
 from fast_autoaugment_tpu.policies.archive import load_policy, policy_to_tensor
 from fast_autoaugment_tpu.train.steps import (
     create_train_state,
@@ -77,10 +77,12 @@ def resolve_policy_tensor(aug: Any):
 def _run_eval(eval_step, params, batch_stats, batches, mesh) -> dict:
     """`batches` yields per-process (images, labels, mask) shards —
     padding/sharding lives in `eval_batches` (one place, multi-host
-    aware), not here."""
+    aware), not here.  Host slicing/decoding and the H2D copy run in
+    the prefetch worker so they overlap the previous batch's device
+    eval."""
     acc = Accumulator()
-    for images, labels, mask in batches:
-        batch = shard_batch(mesh, {"x": images, "y": labels, "m": mask})
+    sharded = prefetch(batches, transform=shard_transform(mesh, ("x", "y", "m")))
+    for batch in sharded:
         acc.add_dict(eval_step(params, batch_stats, batch["x"], batch["y"], batch["m"]))
     return acc.normalize()
 
@@ -280,11 +282,11 @@ def train_and_eval(
                 global_batch, epoch, seed=seed,
                 process_index=jax.process_index(),
                 process_count=jax.process_count(),
-            )
+            ),
+            transform=shard_transform(mesh),
         )
-        for images, labels in batches:
-            batch = shard_batch(mesh, {"x": images, "y": labels})
-            pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
+        pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
+        for batch in batches:
             state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
             acc.add_dict(metrics)
         train_metrics = acc.normalize()

@@ -52,7 +52,7 @@ def test_tta_step_reductions():
     batch-global min; correct must be the per-sample any() across draws."""
     from flax import linen as nn
 
-    from fast_autoaugment_tpu.parallel.mesh import make_mesh
+    from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_transform
     from fast_autoaugment_tpu.search.tta import eval_tta, make_tta_step
 
     class Probe(nn.Module):
@@ -71,8 +71,10 @@ def test_tta_step_reductions():
     images = np.zeros((4, 8, 8, 3), np.uint8)
     images[2:] = 255  # samples 2,3 -> mean 0.5 -> logit 5 -> class 1
     labels = np.array([1, 1, 1, 1], np.int32)
-    out = eval_tta(tta, {}, {}, [(images, labels, np.ones(4, np.float32))],
-                   jnp.zeros((1, 1, 3)), mesh, jax.random.PRNGKey(0))
+    to_device = shard_transform(mesh, ("x", "y", "m"))
+    out = eval_tta(tta, {}, {},
+                   [to_device((images, labels, np.ones(4, np.float32)))],
+                   jnp.zeros((1, 1, 3)), jax.random.PRNGKey(0))
     # samples 0,1 predict class 0 (wrong), 2,3 predict 1 (right)
     assert out["top1_valid"] == pytest.approx(0.5)
     # min nll over all = nll of a correct confident sample
