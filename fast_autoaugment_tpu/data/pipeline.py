@@ -205,32 +205,53 @@ def prefetch(iterator, depth: int = 2, transform=None):
     double-buffered host -> device feed.
 
     `transform(item)` runs in the WORKER thread; passing the mesh's
-    `shard_batch` here starts the host->device copy off the consumer's
-    critical path, so the transfer overlaps the current step's device
-    work instead of serializing with step dispatch (JAX dispatch is
-    thread-safe; the copy lands on the same device stream either way).
+    `shard_transform` here starts the host->device copy off the
+    consumer's critical path, so the transfer overlaps the current
+    step's device work instead of serializing with step dispatch (JAX
+    dispatch is thread-safe; the copy lands on the same device stream
+    either way).
+
+    Abandoning the generator early (``break``, or an exception in the
+    consumer) closes it and stops the worker: every queue put waits in
+    bounded slices against a stop event, so the thread never blocks
+    forever holding buffered batches — with a device-put transform
+    those would be TPU HBM, not just host arrays.
     """
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
+    stop = threading.Event()
     err: list[BaseException] = []
+
+    def put(obj) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(obj, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in iterator:
-                q.put(item if transform is None else transform(item))
+                if not put(item if transform is None else transform(item)):
+                    return
         except BaseException as e:  # propagate into the consumer
             err.append(e)
         finally:
-            q.put(_END)
+            put(_END)
 
     threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 class BatchIterator:

@@ -194,3 +194,76 @@ def test_eval_batches_single_process_pads_to_multiple():
     got = list(eval_batches(ds, None, 4, pad_multiple=4))
     assert [im.shape[0] for im, _, _ in got] == [4, 4]
     assert sum(int(m.sum()) for _, _, m in got) == 5
+
+
+def test_prefetch_transform_runs_in_worker_and_propagates_errors():
+    """prefetch(transform=) applies the mapping off the consumer thread
+    and re-raises worker exceptions (including strict-zip arity errors
+    from shard_transform) at the consumer."""
+    import pytest
+
+    from fast_autoaugment_tpu.data.pipeline import prefetch
+
+    items = [(np.ones((2, 2)), np.zeros(2)), (np.zeros((2, 2)), np.ones(2))]
+    got = list(prefetch(iter(items), transform=lambda t: {"x": t[0], "y": t[1]}))
+    assert [sorted(d) for d in got] == [["x", "y"], ["x", "y"]]
+
+    def boom(_):
+        raise ValueError("bad batch")
+
+    with pytest.raises(ValueError, match="bad batch"):
+        list(prefetch(iter(items), transform=boom))
+
+
+def test_shard_transform_arity_is_strict():
+    """shard_transform must fail loudly when the key tuple does not match
+    the pipeline tuple (a silently dropped mask would surface later as a
+    KeyError far from the call site)."""
+    import jax
+    import pytest
+
+    from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_transform
+
+    mesh = make_mesh(jax.devices()[:1])
+    to_dev = shard_transform(mesh, ("x", "y"))
+    out = to_dev((np.zeros((4, 2, 2, 3), np.uint8), np.zeros(4, np.int32)))
+    assert set(out) == {"x", "y"} and out["x"].shape == (4, 2, 2, 3)
+
+    with pytest.raises(ValueError):
+        shard_transform(mesh, ("x", "y", "m"))(
+            (np.zeros((4, 2, 2, 3), np.uint8), np.zeros(4, np.int32))
+        )
+
+
+def test_prefetch_early_abandon_releases_worker():
+    """Breaking out of a prefetch loop (bench/eval early exit) must stop
+    the worker thread rather than leave it blocked on a full queue
+    holding buffered (possibly device-resident) batches."""
+    import threading
+    import time
+
+    from fast_autoaugment_tpu.data.pipeline import prefetch
+
+    before = threading.active_count()
+    it = prefetch(iter(range(100)), depth=1)
+    assert next(it) == 0
+    it.close()  # what an abandoned for-loop break does on GC
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() == before, "prefetch worker leaked"
+
+
+def test_synthetic_shapes_difficulty_knobs():
+    """The render knobs grade task difficulty: higher noise / lower glyph
+    contrast measurably corrupts the clean image."""
+    from fast_autoaugment_tpu.data.datasets import _synthetic_shapes
+
+    clean_train, _ = _synthetic_shapes(n_train=32, n_test=1)
+    noisy_train, _ = _synthetic_shapes(n_train=32, n_test=1, noise=60.0)
+    faint_train, _ = _synthetic_shapes(n_train=32, n_test=1, fg_lo=5.0, fg_hi=10.0)
+    assert clean_train.images.std() > faint_train.images.std(), \
+        "lower fg contrast must flatten the image"
+    diff = (noisy_train.images.astype(np.float32)
+            - clean_train.images.astype(np.float32))
+    assert np.abs(diff).mean() > 10.0, "higher noise floor must perturb pixels"
