@@ -237,11 +237,15 @@ def train_and_eval(
     best_metric = -1e9
 
     def evaluate(tag_prefix: str, epoch: int) -> dict:
+        # empty splits are SKIPPED, not reported as zeros: with
+        # test_ratio=0 (every phase-3 search retrain) a zero-row per
+        # interval is pure noise, and `metric="valid"` would silently
+        # track a best of 0.0 (the reference only ever evaluates real
+        # splits, train.py:272-280)
         out = {}
         splits = [("valid", valid_it), ("test", test_it)]
         for split, it in splits:
             if len(it) == 0:
-                out[split] = {"loss": 0.0, "top1": 0.0, "top5": 0.0, "num": 0}
                 continue
             eval_kw = dict(
                 process_index=jax.process_index(),
@@ -273,6 +277,19 @@ def train_and_eval(
                 result[f"{k}_{split}"] = v
         result["epoch"] = epoch_start - 1
         return result
+
+    # best-metric guards live AFTER the only_eval return (eval-only runs
+    # never consult `metric`, including resumes that auto-flip only_eval)
+    if metric not in ("last", "train", "valid", "test"):
+        raise ValueError(f"unknown metric {metric!r}: use last/train/valid/test")
+    if metric == "valid" and len(valid_it) == 0:
+        raise ValueError(
+            "metric='valid' with an empty validation split (test_ratio=0): "
+            "the best-checkpoint tracker would silently follow a constant "
+            "0.0 — pass metric='last'/'train'/'test' or a test_ratio > 0"
+        )
+    if metric == "test" and len(test_it) == 0:
+        raise ValueError("metric='test' with an empty test split")
 
     t_start = time.time()
     for epoch in range(epoch_start, epochs + 1):

@@ -244,14 +244,15 @@ def test_prefetch_early_abandon_releases_worker():
 
     from fast_autoaugment_tpu.data.pipeline import prefetch
 
-    before = threading.active_count()
+    before = set(threading.enumerate())
     it = prefetch(iter(range(100)), depth=1)
     assert next(it) == 0
+    spawned = [t for t in threading.enumerate() if t not in before]
+    assert spawned, "prefetch did not spawn a worker thread"
     it.close()  # what an abandoned for-loop break does on GC
-    deadline = time.time() + 5.0
-    while threading.active_count() > before and time.time() < deadline:
-        time.sleep(0.05)
-    assert threading.active_count() == before, "prefetch worker leaked"
+    for t in spawned:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in spawned), "prefetch worker leaked"
 
 
 def test_synthetic_shapes_difficulty_knobs():

@@ -73,6 +73,27 @@ def test_train_and_eval_smoke_with_checkpoint_resume():
         assert result2["top1_test"] == pytest.approx(result["top1_test"], abs=1e-6)
 
 
+def test_empty_valid_split_skipped_and_metric_valid_errors():
+    """With test_ratio=0 (every phase-3 search retrain) the empty valid
+    split must be skipped entirely — no zero-metric rows — and
+    metric='valid' must be a hard error instead of silently tracking a
+    best of 0.0 (reference only evaluates real splits, train.py:272-280)."""
+    from fast_autoaugment_tpu.train.trainer import train_and_eval
+
+    with tempfile.TemporaryDirectory() as tmp:
+        conf = _smoke_conf(aug="default", epoch=1)
+        with pytest.raises(ValueError, match="metric='valid'"):
+            train_and_eval(conf, dataroot=tmp, test_ratio=0.0, metric="valid")
+
+        result = train_and_eval(
+            conf, dataroot=tmp, test_ratio=0.0, evaluation_interval=1,
+            metric="last",
+        )
+        assert not any(k.endswith("_valid") for k in result), \
+            f"empty valid split leaked zero metrics: {sorted(result)}"
+        assert "top1_test" in result  # real split still evaluated
+
+
 def test_train_with_mixup_ema_default_aug():
     from fast_autoaugment_tpu.train.trainer import train_and_eval
 
