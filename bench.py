@@ -40,7 +40,10 @@ BATCH_PER_DEVICE = max(1, int(os.environ.get("FAA_BENCH_BATCH", 128)))
 # timed loop and silently wreck the headline number
 WARMUP_STEPS = max(1, int(os.environ.get("FAA_BENCH_WARMUP", 5)))
 MEASURE_STEPS = max(1, int(os.environ.get("FAA_BENCH_STEPS", 30)))
-PREFETCH_DEPTH = max(1, int(os.environ.get("FAA_BENCH_PREFETCH", 4)))
+#  default: cpu-count-gated (docs/loader_bench.md — depth >1 hurts on a
+#  1-core host); override with FAA_BENCH_PREFETCH
+_env_depth = os.environ.get("FAA_BENCH_PREFETCH")
+PREFETCH_DEPTH = max(1, int(_env_depth)) if _env_depth else None
 
 # peak dense bf16 FLOP/s per chip by generation (public spec sheets);
 # MFU is computed against the matching entry, else reported as null
@@ -90,6 +93,17 @@ def _step_flops(lowered_compiled) -> float | None:
         return None
 
 
+def _probe_backend_once(probe_timeout: float) -> int:
+    """Device-init probe in a throwaway subprocess; 0 = chip reachable."""
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout, capture_output=True,
+        ).returncode
+    except subprocess.TimeoutExpired:
+        return -1
+
+
 def _ensure_live_backend(reexec_argv=None, fallback_env=None):
     """Fall back to a clean CPU env when the TPU tunnel is dead.
 
@@ -104,22 +118,32 @@ def _ensure_live_backend(reexec_argv=None, fallback_env=None):
     never masquerade as a TPU number.  Shared by the sibling benchmark
     tools (e.g. tools/bench_models.py), which pass their own argv and
     fallback knobs.
+
+    Tunnel flaps are often transient (round 2 lost its official TPU
+    number to one dead probe at capture time), so a failed probe is
+    retried every FAA_BENCH_RETRY_SECS (60 s) within a bounded
+    FAA_BENCH_RETRY_WINDOW (900 s) before surrendering to the CPU
+    fallback (VERDICT round 2, next-step 2).
     """
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return  # nothing registered that could hang
     probe_timeout = float(os.environ.get("FAA_BENCH_PROBE_TIMEOUT", 240))
     if probe_timeout <= 0:
         return  # probe disabled: trust the chip, skip the extra init
-    try:
-        rc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout, capture_output=True,
-        ).returncode
-    except subprocess.TimeoutExpired:
-        rc = -1
+    retry_window = float(os.environ.get("FAA_BENCH_RETRY_WINDOW", 900))
+    retry_secs = max(1.0, float(os.environ.get("FAA_BENCH_RETRY_SECS", 60)))
+    deadline = time.monotonic() + retry_window
+    rc = _probe_backend_once(probe_timeout)
+    while rc != 0 and time.monotonic() < deadline:
+        wait = min(retry_secs, max(0.0, deadline - time.monotonic()))
+        _log(f"TPU backend probe failed (rc={rc}); re-probing in {wait:.0f}s "
+             f"(window closes in {deadline - time.monotonic():.0f}s)")
+        time.sleep(wait)
+        rc = _probe_backend_once(probe_timeout)
     if rc == 0:
         return  # chip reachable; run the real benchmark
-    _log(f"TPU backend probe failed (rc={rc}); re-exec on clean CPU env")
+    _log(f"TPU backend probe failed (rc={rc}) for the whole retry window; "
+         "re-exec on clean CPU env")
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
     env["FAA_BENCH_CPU_FALLBACK"] = "1"
@@ -237,6 +261,10 @@ def main():
     jax.block_until_ready(state.params)
     dt_hf = time.perf_counter() - t0
     hostfeed = hf_steps * global_batch / dt_hf / n_dev if hf_steps else None
+    # release the worker and its buffered device-resident batches NOW,
+    # not when main() returns (the generator holds up to `depth` batches
+    # in HBM otherwise)
+    it.close()
 
     out = {
         "metric": "wrn40x2_cifar10_train_images_per_sec_per_chip",
@@ -248,12 +276,41 @@ def main():
         "batch_per_device": BATCH_PER_DEVICE,
         "devices": n_dev,
     }
+    latest_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", "bench_tpu_latest.json")
     if os.environ.get("FAA_BENCH_CPU_FALLBACK"):
         out["backend"] = "cpu-fallback"
         out["note"] = (
-            "TPU tunnel unreachable at bench time; this is a CPU plumbing "
-            "number. See docs/BENCHMARKS.md for the measured TPU result."
+            "TPU tunnel unreachable for the whole bench retry window; this "
+            "is a CPU plumbing number. `last_tpu` carries the most recent "
+            "successful TPU measurement (docs/bench_tpu_latest.json)."
         )
+        # cite the persisted last-good TPU measurement so the official
+        # record never regresses to CPU-only evidence (VERDICT round 2)
+        try:
+            with open(latest_path) as fh:
+                out["last_tpu"] = json.load(fh)
+        except (OSError, ValueError):  # missing OR truncated/corrupt
+            out["last_tpu"] = None
+    else:
+        platform = getattr(jax.devices()[0], "platform", "unknown")
+        out["backend"] = platform
+        if platform != "cpu":
+            # persist this successful hardware measurement for future
+            # fallback runs to cite (checked in alongside the round docs)
+            import datetime
+
+            try:
+                tmp_path = latest_path + ".tmp"
+                with open(tmp_path, "w") as fh:
+                    json.dump({
+                        "captured_at": datetime.datetime.now(
+                            datetime.timezone.utc).isoformat(timespec="seconds"),
+                        **out,
+                    }, fh, indent=1)
+                os.replace(tmp_path, latest_path)  # atomic: no torn reads
+            except OSError as e:
+                _log(f"could not persist {latest_path}: {e}")
     print(json.dumps(out))
 
 

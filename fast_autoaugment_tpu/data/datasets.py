@@ -308,6 +308,11 @@ def load_dataset(dataset: str, dataroot: str):
         # 15 samples/class (render params unchanged) — leaves measured
         # test-accuracy headroom for searched policies
         return _synthetic_shapes(n_train=150)
+    if dataset.startswith("synthetic_shapes_n"):
+        # parametrized train-set size (synthetic_shapes_n120 -> 120
+        # samples, render unchanged): the difficulty dial for grading
+        # search-validation headroom (docs/search_postmortem_r2.md #4)
+        return _synthetic_shapes(n_train=int(dataset.rsplit("n", 1)[1]))
     if dataset.startswith("synthetic"):
         # synthetic / synthetic_cifar100-style names for tests and benches
         num_classes = 100 if dataset.endswith("100") else 10

@@ -6,10 +6,14 @@ The reference prepares ImageNet with torchvision-style helpers
 ``meta.mat`` for the synset table, read the val ground-truth index
 list, and physically reorganize the flat ``val/`` download into
 per-wnid class folders so the plain ImageFolder reader applies.  This
-module supplies the same capabilities for local trees.  The tar
-*download* machinery (``imagenet.py:180-192``) is deliberately absent:
-this build environment is zero-egress, and the framework consumes
-already-extracted trees (documented deviation, docs/PARITY.md).
+module supplies the same capabilities for local trees, plus the tar
+fetch/verify/extract pipeline (reference ``imagenet.py:164-231``):
+the archive URL/md5 table, an integrity-gated fetch, safe tar
+extraction, and the per-class inner-tar expansion of the train split.
+Everything is offline-testable (``file://`` URLs, fabricated tars —
+``tests/test_imagenet_tools.py``); in this zero-egress build
+environment the fetch path never sees the real hosts, which is an
+environmental limit, not a missing capability.
 
 A listfile *generator* is added (the reference only consumes
 ``train_cls.txt``, it never ships one): it emits the Kaggle CLS-LOC
@@ -19,18 +23,142 @@ line, extension stripped (reference ``imagenet.py:60-88``).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
+import tarfile
 
 __all__ = [
+    "ARCHIVES",
     "parse_meta_mat",
     "parse_val_groundtruth",
     "parse_devkit",
     "prepare_val_folder",
     "write_listfile",
+    "md5sum",
+    "fetch",
+    "extract_tar",
+    "prepare_train_folder",
+    "download_and_extract",
 ]
 
 IMG_EXTENSIONS = (".jpeg", ".jpg", ".png", ".bmp", ".webp")
+
+# The ILSVRC2012 release artifacts: public URLs + published md5s
+# (the reference's ARCHIVE_DICT, ``imagenet.py:6-19`` — a fixed data
+# table, reproduced as data).
+ARCHIVES: dict[str, dict[str, str]] = {
+    "train": {
+        "url": "http://www.image-net.org/challenges/LSVRC/2012/nnoupb/ILSVRC2012_img_train.tar",
+        "md5": "1d675b47d978889d74fa0da5fadfb00e",
+    },
+    "val": {
+        "url": "http://www.image-net.org/challenges/LSVRC/2012/nnoupb/ILSVRC2012_img_val.tar",
+        "md5": "29b22e2961454d5413ddabcf34fc5622",
+    },
+    "devkit": {
+        "url": "http://www.image-net.org/challenges/LSVRC/2012/nnoupb/ILSVRC2012_devkit_t12.tar.gz",
+        "md5": "fa75699e90414af021442c21a62c3abf",
+    },
+}
+
+
+def md5sum(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as fh:
+        while block := fh.read(chunk):
+            h.update(block)
+    return h.hexdigest()
+
+
+def fetch(url: str, dest_dir: str, *, filename: str | None = None,
+          md5: str | None = None) -> str:
+    """Integrity-gated fetch: skip the transfer when the target file
+    already exists with the right checksum, verify after download, fail
+    loudly on mismatch (reference ``imagenet.py:186-190`` via
+    torchvision's ``download_url``/``check_integrity``).  Plain urllib —
+    ``file://`` URLs work, which is how the zero-egress tests drive it.
+    """
+    import urllib.request
+
+    os.makedirs(dest_dir, exist_ok=True)
+    filename = filename or os.path.basename(url)
+    target = os.path.join(dest_dir, filename)
+    if os.path.exists(target) and (md5 is None or md5sum(target) == md5):
+        return target
+    urllib.request.urlretrieve(url, target)
+    if md5 is not None and (got := md5sum(target)) != md5:
+        raise IOError(f"{target}: md5 {got} != expected {md5} — corrupt download")
+    return target
+
+
+def extract_tar(src: str, dest: str | None = None, *, gzip: bool | None = None,
+                delete: bool = False) -> str:
+    """Safe tar extraction (reference ``imagenet.py:164-177``): refuses
+    absolute paths / parent traversal in member names (the reference's
+    bare ``extractall`` trusts the archive)."""
+    dest = dest or os.path.dirname(src)
+    if gzip is None:
+        gzip = src.lower().endswith(".gz")
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(src, "r:gz" if gzip else "r") as tar:
+        for member in tar.getmembers():
+            name = member.name
+            if os.path.isabs(name) or ".." in name.split("/"):
+                raise ValueError(f"{src}: unsafe member path {name!r}")
+            if member.issym() or member.islnk():
+                link = member.linkname
+                if os.path.isabs(link) or ".." in link.split("/"):
+                    raise ValueError(
+                        f"{src}: unsafe link member {name!r} -> {link!r}"
+                    )
+        try:
+            tar.extractall(dest, filter="data")  # py>=3.12 semantics
+        except TypeError:  # older tarfile without the filter kwarg;
+            tar.extractall(dest)  # manual name+link checks above apply
+    if delete:
+        os.remove(src)
+    return dest
+
+
+def prepare_train_folder(folder: str) -> int:
+    """Expand the train split's per-class inner tars
+    (``n01440764.tar`` -> ``n01440764/``; reference
+    ``imagenet.py:224-226``); returns #archives expanded.  Idempotent:
+    already-expanded trees have no loose .tar files left."""
+    n = 0
+    for name in sorted(os.listdir(folder)):
+        if not name.endswith(".tar"):
+            continue
+        src = os.path.join(folder, name)
+        extract_tar(src, os.path.join(folder, os.path.splitext(name)[0]),
+                    gzip=False, delete=True)
+        n += 1
+    return n
+
+
+def download_and_extract(split: str, root: str, *,
+                         url: str | None = None, md5: str | None = None) -> str:
+    """Fetch + verify + extract one ILSVRC2012 archive into
+    ``<root>/<split>`` and post-process it (train: inner per-class tars;
+    val: left flat for :func:`prepare_val_folder`; devkit: extracted
+    in-place).  `url`/`md5` override the table for mirrors and tests.
+    Returns the extraction directory (reference ``imagenet.py:101-131``).
+    """
+    if split not in ARCHIVES:
+        raise KeyError(f"unknown split {split!r}: {sorted(ARCHIVES)}")
+    spec = ARCHIVES[split]
+    url = url or spec["url"]
+    md5 = spec["md5"] if md5 is None else (md5 or None)
+    archive = fetch(url, root, md5=md5)
+    if split == "devkit":
+        extract_tar(archive, root)
+        return os.path.join(root, "ILSVRC2012_devkit_t12")
+    dest = os.path.join(root, split)
+    extract_tar(archive, dest)
+    if split == "train":
+        prepare_train_folder(dest)
+    return dest
 
 
 def parse_meta_mat(devkit_root: str):

@@ -18,6 +18,7 @@ iterate deterministically without dropping.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Iterator
@@ -200,9 +201,29 @@ def num_train_steps(n_examples: int, global_batch: int) -> int:
     return n_examples // global_batch
 
 
-def prefetch(iterator, depth: int = 2, transform=None):
+def default_prefetch_depth() -> int:
+    """Measured-default queue depth (docs/loader_bench.md): on a
+    single-core host the worker and consumer fight over the one CPU, so
+    any depth beyond 1 only adds queue contention (237 img/s at depth 1
+    vs ~180 at depth 2-8 on this box); with >=2 cores, 2 buffers the
+    decode burst while the consumer dispatches the previous batch."""
+    return 1 if (os.cpu_count() or 1) < 2 else 2
+
+
+def prefetch(iterator, depth: int | None = None, transform=None):
     """Run `iterator` in a background thread with a bounded queue —
-    double-buffered host -> device feed.
+    double-buffered host -> device feed.  `depth=None` uses
+    :func:`default_prefetch_depth` (cpu-count gated, measured in
+    docs/loader_bench.md).
+
+    ``depth=0`` (or ``FAA_PREFETCH_SYNC=1`` for default-depth callers —
+    an explicit depth always wins) degrades to a synchronous inline
+    loop — no worker thread.  The test suite sets the env var: on the
+    virtual 8-device CPU mesh the worker's `jax.device_put` races the
+    consumer's dispatch inside the CPU PJRT client and intermittently
+    SIGABRTs the process (observed round 3, twice, same two-thread
+    signature); on a single-core host the thread buys no overlap
+    anyway.  The TPU production path keeps the async worker.
 
     `transform(item)` runs in the WORKER thread; passing the mesh's
     `shard_transform` here starts the host->device copy off the
@@ -217,6 +238,20 @@ def prefetch(iterator, depth: int = 2, transform=None):
     forever holding buffered batches — with a device-put transform
     those would be TPU HBM, not just host arrays.
     """
+    if depth is None:
+        # env override applies only to default-depth callers — an
+        # explicit depth is an explicit choice; "0"/"" mean unset
+        if os.environ.get("FAA_PREFETCH_SYNC", "0") not in ("", "0"):
+            depth = 0
+        else:
+            depth = default_prefetch_depth()
+    if depth == 0:
+        # NOTE prefetch is a generator function (the async path below
+        # yields): the sync path must yield inline, not return a
+        # sub-generator
+        for item in iterator:
+            yield (transform(item) if transform is not None else item)
+        return
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
     stop = threading.Event()

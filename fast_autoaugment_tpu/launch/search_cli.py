@@ -45,6 +45,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--smoke-test", action="store_true")
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fold-quality-floor", type=float, default=None,
+                   help="fold-oracle gate: retrain (fresh seed) folds whose "
+                        "no-policy baseline accuracy is below this, exclude "
+                        "them from ranking if still weak (None disables; "
+                        "docs/search_postmortem_r2.md)")
+    p.add_argument("--fold-retrain-tries", type=int, default=2)
+    p.add_argument("--phase1-epochs", type=int, default=None,
+                   help="override conf['epoch'] for phase-1 fold pretraining")
+    p.add_argument("--audit-floor", type=float, default=0.7,
+                   help="drop selected sub-policies whose standalone "
+                        "mean-over-draws fold accuracy < floor x baseline "
+                        "(<=0 disables)")
     p.add_argument("override", nargs="*")
     return p
 
@@ -69,6 +81,10 @@ def main(argv=None):
         until=args.until,
         folds=[int(f) for f in args.folds.split(",")] if args.folds else None,
         seed=args.seed,
+        fold_quality_floor=args.fold_quality_floor,
+        fold_retrain_tries=args.fold_retrain_tries,
+        phase1_epochs=args.phase1_epochs,
+        audit_floor=args.audit_floor if args.audit_floor > 0 else None,
     )
     final_policy_set = result["final_policy_set"]
     logger.info("final policy set: %d sub-policies", len(final_policy_set))

@@ -26,6 +26,8 @@ __all__ = ["get_model", "num_class", "input_image_size"]
 
 def num_class(dataset: str) -> int:
     """Class count per dataset (reference ``networks/__init__.py:93-103``)."""
+    if dataset.startswith("synthetic_shapes"):
+        return 10  # glyph task is always 10-class (any _nN train size)
     if dataset.startswith("synthetic"):
         return 100 if dataset.endswith("100") else 10
     return {

@@ -15,12 +15,30 @@ Differences by design:
 - TPE is in-tree (``search/tpe.py``).
 - "GPU-hours" accounting (``search.py:132-133,251``) becomes
   TPU-seconds = wall x device_count, reported per phase.
+
+Additions beyond the reference (round-2 post-mortem,
+``docs/search_postmortem_r2.md`` — the reference has neither and its
+pipeline silently selected accuracy-destroying policies in our round-2
+validation run):
+- a **fold-oracle quality gate**: after phase 1 each fold model's
+  no-candidate-policy baseline accuracy is measured with the compiled
+  TTA step; folds below ``fold_quality_floor`` are retrained with a
+  fresh seed up to ``fold_retrain_tries`` times and excluded from
+  ranking if still weak (a 0.37-accuracy oracle cannot rank policies);
+- a **per-sub-policy audit**: every sub-policy surviving the
+  reference's top-N selection is evaluated ALONE under the
+  *mean*-over-draws reduction (training-time semantics) and dropped
+  when it degrades fold accuracy below ``audit_floor`` x baseline —
+  the reference's max-over-draws reward (``search.py:116-125``) lets a
+  destructive sub-policy hide behind one benign draw of its trial
+  siblings.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 from typing import Callable
 
@@ -71,6 +89,155 @@ def _fold_ckpt_path(save_dir: str, conf, fold: int, cv_ratio: float) -> str:
     return os.path.join(save_dir, f"{tag}.msgpack")
 
 
+# every per-checkpoint artifact train_and_eval emits: the msgpack, the
+# cheap-metadata sidecar, and the ScalarWriter logs — retry promotion
+# must move/remove all of them or the promoted fold keeps the rejected
+# run's training curves
+_CKPT_SUFFIXES = ("", ".meta.json", "_train.jsonl", "_valid.jsonl", "_test.jsonl")
+
+
+def _replace_ckpt(src: str, dst: str):
+    """Promote a retrained fold checkpoint (+ all sidecars)."""
+    for suffix in _CKPT_SUFFIXES:
+        if os.path.exists(dst + suffix):
+            os.remove(dst + suffix)
+        if os.path.exists(src + suffix):
+            shutil.move(src + suffix, dst + suffix)
+
+
+def _remove_ckpt(path: str):
+    for suffix in _CKPT_SUFFIXES:
+        if os.path.exists(path + suffix):
+            os.remove(path + suffix)
+
+
+class _FoldEval:
+    """Lazily-built TTA machinery shared by the fold-quality gate,
+    phase 2 and the sub-policy audit: one compiled step, per-fold
+    device-resident batch caches, a checkpoint template."""
+
+    def __init__(self, conf, dataroot, mesh, *, num_policy, num_op, cv_ratio, seed):
+        self.conf, self.dataroot, self.mesh = conf, dataroot, mesh
+        self.num_policy, self.num_op = num_policy, num_op
+        self.cv_ratio, self.seed = cv_ratio, seed
+        self._built = False
+        self._batches: dict[int, Callable] = {}
+
+    def _build(self):
+        if self._built:
+            return
+        conf, mesh = self.conf, self.mesh
+        dataset_name = conf["dataset"]
+        num_classes = num_class(dataset_name)
+        self.num_classes = num_classes
+        self.total_train, _test = load_dataset(dataset_name, self.dataroot)
+        model_conf = dict(conf["model"], dataset=dataset_name)
+        model_conf.setdefault("precision", conf.get("precision", "f32"))
+        model = get_model(model_conf, num_classes)
+        cutout_length = int(conf.get("cutout", 0) or 0)
+
+        # the TTA loaders use the TRAIN transform stack (the reference's
+        # validloader shares the train dataset's transforms, data.py:88-112)
+        from fast_autoaugment_tpu.models import input_image_size
+
+        # same conf['imgsize'] override as train_and_eval — phase 2 must
+        # evaluate the phase-1 checkpoints at the resolution they trained at
+        image = int(conf.get("imgsize", 0) or 0) or input_image_size(
+            dataset_name, conf["model"]["type"]
+        )
+        self.image = image
+        if dataset_name.endswith("imagenet"):
+            from fast_autoaugment_tpu.ops.preprocess_imagenet import (
+                imagenet_train_batch,
+                random_crop_box,
+            )
+
+            tta_augment_fn = lambda images, pol, key: imagenet_train_batch(  # noqa: E731
+                images, key, pol, cutout_length=cutout_length
+            )
+            self._box_fn = lambda rng, w, h: random_crop_box(rng, w, h, image)  # noqa: E731
+        else:
+            tta_augment_fn = None
+            self._box_fn = None
+        self.tta_step = make_tta_step(
+            model, num_policy=self.num_policy, cutout_length=cutout_length,
+            augment_fn=tta_augment_fn,
+        )
+
+        # checkpoint template, built once (models are input-size-polymorphic
+        # after init, but use the real resolution for clarity)
+        from fast_autoaugment_tpu.ops.optim import build_optimizer
+        from fast_autoaugment_tpu.train.steps import create_train_state
+
+        sample = jnp.zeros((2, image, image, 3), jnp.float32)
+        optimizer = build_optimizer(dict(conf["optimizer"]), lambda s: 0.0)
+        self.template = create_train_state(
+            model, optimizer, jax.random.PRNGKey(0), sample,
+            use_ema=bool(conf.get("optimizer", {}).get("ema", 0)),
+        )
+        self._built = True
+
+    def load_fold(self, path: str):
+        self._build()
+        state = load_checkpoint(path, self.template)
+        return state.params, state.batch_stats
+
+    def batches_fn(self, fold: int) -> Callable:
+        """Batch source for a fold's held-out split.  In-memory datasets
+        upload the fold ONCE and replay the device-resident batches for
+        every trial (the data never changes between TPE samples — only
+        the policy tensor does); lazy on-disk datasets (ImageNet) stream
+        through a prefetch worker."""
+        self._build()
+        if fold in self._batches:
+            return self._batches[fold]
+        from fast_autoaugment_tpu.data.pipeline import BatchIterator
+        from fast_autoaugment_tpu.parallel.mesh import shard_transform
+
+        _train_idx, valid_idx = cv_split(self.total_train.labels, self.cv_ratio, fold)
+        batch = int(self.conf["batch"]) * self.mesh.size
+        fold_it = BatchIterator(
+            self.total_train, valid_idx,
+            eval_box_fn=self._box_fn, train_box_fn=self._box_fn,
+            imgsize=self.image,
+        )
+
+        def _stream():
+            return fold_it.eval_epoch(
+                batch, process_index=jax.process_index(),
+                process_count=jax.process_count(), pad_multiple=self.mesh.size,
+            )
+
+        _to_device = shard_transform(self.mesh, ("x", "y", "m"))
+        if not self.total_train.lazy:
+            cached = [_to_device(t) for t in _stream()]
+            fn = lambda: iter(cached)  # noqa: E731
+        else:
+            from fast_autoaugment_tpu.data.pipeline import prefetch
+
+            fn = lambda: prefetch(_stream(), transform=_to_device)  # noqa: E731
+        self._batches[fold] = fn
+        return fn
+
+    def evaluate(self, fold: int, params, batch_stats, policy_t, key) -> dict:
+        return eval_tta(
+            self.tta_step, params, batch_stats, self.batches_fn(fold)(),
+            policy_t, key,
+        )
+
+    def baseline(self, fold: int, path: str) -> float:
+        """No-candidate-policy fold accuracy: the identity policy (one
+        all-zero sub-policy row: op 0 gated at prob 0) through the same
+        compiled step — i.e. fold accuracy under the default transform
+        stack alone.  The oracle-quality measure the gate and audit
+        normalize against."""
+        params, batch_stats = self.load_fold(path)
+        ident = jnp.zeros((1, self.num_op, 3), jnp.float32)
+        out = self.evaluate(fold, params, batch_stats, ident,
+                            jax.random.PRNGKey(17))
+        return float(out["top1_mean"])
+
+
 def search_policies(
     conf,
     dataroot: str,
@@ -88,6 +255,10 @@ def search_policies(
     until: int = 2,
     folds: list[int] | None = None,
     seed: int = 0,
+    fold_quality_floor: float | None = None,
+    fold_retrain_tries: int = 2,
+    phase1_epochs: int | None = None,
+    audit_floor: float | None = None,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -101,6 +272,17 @@ def search_policies(
     ``--folds k``, then one host merges the per-fold trial JSONs by
     rerunning with all folds, which resumes instantly from the merged
     trial state).
+
+    `fold_quality_floor` enables the fold-oracle quality gate: folds
+    whose no-policy baseline accuracy stays below the floor after
+    `fold_retrain_tries` fresh-seed retrains are excluded from ranking.
+    `phase1_epochs` overrides conf['epoch'] for phase-1 fold pretraining
+    only (weak oracles on small folds are usually under-trained, not
+    under-parameterized).  `audit_floor` (None disables) drops any
+    selected sub-policy whose standalone mean-over-draws fold accuracy
+    falls below ``audit_floor x fold_baseline`` averaged over the folds
+    that pass the gate.  All three are additions over the reference —
+    see the module docstring and docs/search_postmortem_r2.md.
 
     Single-host scheduling is deliberately sequential (VERDICT round 1,
     next-step 9): phase-1 fold training and phase-2 TTA evaluation are
@@ -135,9 +317,18 @@ def search_policies(
     def _fold_searched(fold: int) -> bool:
         return len(trials_log.get(str(fold), [])) >= num_search
 
+    evaluator = _FoldEval(
+        conf, dataroot, mesh,
+        num_policy=num_policy, num_op=num_op, cv_ratio=cv_ratio, seed=seed,
+    )
+    fold_baselines: dict[int, float] = {}
+    excluded_folds: list[int] = []
+
     # ---------------- phase 1: pretrain without augmentation ----------
     t0 = time.time()
     no_aug_conf = conf.replace(aug="default")
+    if phase1_epochs:
+        no_aug_conf = no_aug_conf.replace(epoch=int(phase1_epochs))
     fold_paths = []
     for fold in range(cv_num):
         path = _fold_ckpt_path(save_dir, conf, fold, cv_ratio)
@@ -145,23 +336,83 @@ def search_policies(
         if fold not in fold_list:
             continue
         if _fold_searched(fold):
-            # merged trial state from another host: nothing left to train
+            # merged trial state from another host: nothing left to train,
+            # but the quality gate still applies — a resumed weak oracle
+            # must not rank policies (its trial budget is spent, so no
+            # retrain: measure and exclude only)
             logger.info("phase1: fold %d already searched (merged trials)", fold)
+            if fold_quality_floor is not None:
+                if os.path.exists(path):
+                    acc = evaluator.baseline(fold, path)
+                    fold_baselines[fold] = acc
+                    if acc < fold_quality_floor:
+                        logger.warning(
+                            "phase1: resumed fold %d baseline %.3f below "
+                            "floor %.3f — EXCLUDED from ranking", fold, acc,
+                            fold_quality_floor,
+                        )
+                        excluded_folds.append(fold)
+                else:
+                    logger.warning(
+                        "phase1: fold %d searched elsewhere and its "
+                        "checkpoint is not on this host — quality gate "
+                        "cannot assess it; trials rank ungated", fold,
+                    )
             continue
         meta = read_metadata(path)
-        if resume and meta and meta.get("epoch", 0) >= int(conf["epoch"]):
-            logger.info("phase1: fold %d already trained (epoch %d)", fold, meta["epoch"])
-            continue
-        logger.info("phase1: training fold %d -> %s", fold, path)
-        if train_fold_fn is not None:
-            train_fold_fn(no_aug_conf, fold, path)
+        if not (resume and meta and meta.get("epoch", 0) >= int(no_aug_conf["epoch"])):
+            logger.info("phase1: training fold %d -> %s", fold, path)
+            if train_fold_fn is not None:
+                train_fold_fn(no_aug_conf, fold, path)
+            else:
+                train_and_eval(
+                    no_aug_conf, dataroot,
+                    test_ratio=cv_ratio, cv_fold=fold,
+                    save_path=path, metric="last", seed=seed,
+                )
         else:
-            train_and_eval(
-                no_aug_conf, dataroot,
-                test_ratio=cv_ratio, cv_fold=fold,
-                save_path=path, metric="last", seed=seed,
+            logger.info("phase1: fold %d already trained (epoch %d)", fold, meta["epoch"])
+
+        # fold-oracle quality gate (round-2 post-mortem: fold baselines
+        # of 0.37-0.65 produced a reward signal that ranked destructive
+        # policies on top)
+        if fold_quality_floor is None:
+            continue
+        acc = evaluator.baseline(fold, path)
+        tries = 0
+        while acc < fold_quality_floor and tries < fold_retrain_tries:
+            tries += 1
+            alt = f"{path}.retry{tries}"
+            logger.warning(
+                "phase1: fold %d baseline %.3f < floor %.3f — retraining "
+                "with a fresh seed (try %d/%d)",
+                fold, acc, fold_quality_floor, tries, fold_retrain_tries,
             )
+            _remove_ckpt(alt)
+            train_and_eval(
+                no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
+                save_path=alt, metric="last", seed=seed + 1009 * tries + fold,
+            )
+            alt_acc = evaluator.baseline(fold, alt)
+            if alt_acc > acc:
+                _replace_ckpt(alt, path)
+                acc = alt_acc
+            else:
+                _remove_ckpt(alt)
+        fold_baselines[fold] = acc
+        if acc < fold_quality_floor:
+            logger.warning(
+                "phase1: fold %d baseline %.3f still below floor %.3f after "
+                "%d retrains — EXCLUDED from policy ranking",
+                fold, acc, fold_quality_floor, fold_retrain_tries,
+            )
+            excluded_folds.append(fold)
+        else:
+            logger.info("phase1: fold %d baseline %.3f (floor %.3f) ok",
+                        fold, acc, fold_quality_floor)
     result["tpu_secs_phase1"] = (time.time() - t0) * mesh.size
+    result["fold_baselines"] = {str(k): v for k, v in fold_baselines.items()}
+    result["excluded_folds"] = list(excluded_folds)
     if until < 2:
         result["final_policy_set"] = []
         result["elapsed_total"] = time.time() - watch["start"]
@@ -169,96 +420,23 @@ def search_policies(
 
     # ---------------- phase 2: TPE search per fold --------------------
     t0 = time.time()
-    dataset_name = conf["dataset"]
-    num_classes = num_class(dataset_name)
-    total_train, _test = load_dataset(dataset_name, dataroot)
-    model_conf = dict(conf["model"], dataset=dataset_name)
-    model_conf.setdefault("precision", conf.get("precision", "f32"))
-    model = get_model(model_conf, num_classes)
-    cutout_length = int(conf.get("cutout", 0) or 0)
-
-    # the TTA loaders use the TRAIN transform stack (the reference's
-    # validloader shares the train dataset's transforms, data.py:88-112)
-    from fast_autoaugment_tpu.data.pipeline import BatchIterator
-    from fast_autoaugment_tpu.models import input_image_size
-
-    # same conf['imgsize'] override as train_and_eval — phase 2 must
-    # evaluate the phase-1 checkpoints at the resolution they trained at
-    image = int(conf.get("imgsize", 0) or 0) or input_image_size(
-        dataset_name, conf["model"]["type"]
-    )
-    if dataset_name.endswith("imagenet"):
-        from fast_autoaugment_tpu.ops.preprocess_imagenet import (
-            imagenet_train_batch,
-            random_crop_box,
-        )
-
-        tta_augment_fn = lambda images, pol, key: imagenet_train_batch(  # noqa: E731
-            images, key, pol, cutout_length=cutout_length
-        )
-        box_fn = lambda rng, w, h: random_crop_box(rng, w, h, image)  # noqa: E731
-    else:
-        tta_augment_fn = None
-        box_fn = None
-    tta_step = make_tta_step(
-        model, num_policy=num_policy, cutout_length=cutout_length,
-        augment_fn=tta_augment_fn,
-    )
-
-    # checkpoint template, built once (models are input-size-polymorphic
-    # after init, but use the real resolution for clarity)
-    from fast_autoaugment_tpu.ops.optim import build_optimizer
-    from fast_autoaugment_tpu.train.steps import create_train_state
-
-    sample = jnp.zeros((2, image, image, 3), jnp.float32)
-    optimizer = build_optimizer(dict(conf["optimizer"]), lambda s: 0.0)
-    template = create_train_state(
-        model, optimizer, jax.random.PRNGKey(0), sample,
-        use_ema=bool(conf.get("optimizer", {}).get("ema", 0)),
-    )
-
     space = make_search_space(num_policy, num_op)
     final_policy_set = []
 
     for fold in fold_list:
+        if fold in excluded_folds:
+            logger.info("phase2: fold %d excluded by the quality gate", fold)
+            continue
         if _fold_searched(fold):
             logger.info("phase2: fold %d trials already complete", fold)
             continue
-        path = fold_paths[fold]
-        state = load_checkpoint(path, template)
-        params, batch_stats = state.params, state.batch_stats
+        params, batch_stats = evaluator.load_fold(fold_paths[fold])
 
-        _train_idx, valid_idx = cv_split(total_train.labels, cv_ratio, fold)
-        batch = int(conf["batch"]) * mesh.size
-        fold_it = BatchIterator(
-            total_train, valid_idx,
-            eval_box_fn=box_fn, train_box_fn=box_fn, imgsize=image,
-        )
-
-        def _fold_batches():
-            return fold_it.eval_epoch(
-                batch, process_index=jax.process_index(),
-                process_count=jax.process_count(), pad_multiple=mesh.size,
-            )
-
-        # in-memory datasets: upload the fold ONCE and replay the
-        # device-resident batches for all `num_search` trials (the data
-        # never changes between TPE samples — only the policy tensor
-        # does; saves num_search x (host slice + H2D) per fold).  Lazy
-        # on-disk datasets (ImageNet) keep the streaming path.
-        from fast_autoaugment_tpu.parallel.mesh import shard_transform
-
-        _to_device = shard_transform(mesh, ("x", "y", "m"))
-        if not total_train.lazy:
-            cached = [_to_device(t) for t in _fold_batches()]
-            _fold_batches = lambda: iter(cached)  # noqa: E731
-        else:
-            from fast_autoaugment_tpu.data.pipeline import prefetch
-
-            _stream = _fold_batches
-            _fold_batches = lambda: prefetch(_stream(), transform=_to_device)  # noqa: E731
-
-        tpe = TPE(space, seed=seed * 1000 + fold)
+        # small budgets keep some TPE engagement: the hyperopt default
+        # n_startup=20 leaves a 60-trial run barely out of the random
+        # phase (round-2 run; docs/tpe_benchmark.md)
+        tpe = TPE(space, seed=seed * 1000 + fold,
+                  n_startup=min(20, max(5, num_search // 4)))
         key_fold = jax.random.PRNGKey(seed * 77 + fold)
         fold_trials = trials_log.get(str(fold), [])
         for sample_dict, reward in fold_trials:  # resume previous trials
@@ -269,9 +447,9 @@ def search_policies(
             proposal = tpe.suggest()
             policies = policy_decoder(proposal, num_policy, num_op)
             policy_t = jnp.asarray(policy_to_tensor(policies))
-            metrics = eval_tta(
-                tta_step, params, batch_stats, _fold_batches(),
-                policy_t, jax.random.fold_in(key_fold, trial_idx),
+            metrics = evaluator.evaluate(
+                fold, params, batch_stats, policy_t,
+                jax.random.fold_in(key_fold, trial_idx),
             )
             tpe.tell(proposal, metrics["top1_valid"])
             fold_trials.append((proposal, metrics["top1_valid"]))
@@ -296,6 +474,10 @@ def search_policies(
         if not 0 <= int(fold_key) < cv_num:
             logger.warning("ignoring stale fold %s in trial log", fold_key)
             continue
+        if int(fold_key) in excluded_folds:
+            logger.warning("fold %s excluded by the quality gate — its "
+                           "trials do not rank", fold_key)
+            continue
         if len(fold_trials) < num_search:
             logger.warning(
                 "fold %s has %d/%d trials — incomplete, excluded from the "
@@ -307,8 +489,25 @@ def search_policies(
             final_policy_set.extend(policy_decoder(proposal, num_policy, num_op))
 
     final_policy_set = remove_duplicates(final_policy_set)
-    result["final_policy_set"] = final_policy_set
+    result["num_sub_policies_selected"] = len(final_policy_set)
     result["tpu_secs_phase2"] = (time.time() - t0) * mesh.size
+
+    # ---------------- phase 2.5: per-sub-policy audit -----------------
+    if audit_floor is not None and final_policy_set:
+        t0 = time.time()
+        final_policy_set, audit = audit_sub_policies(
+            evaluator, final_policy_set, fold_paths,
+            fold_baselines=fold_baselines,
+            candidate_folds=[f for f in range(cv_num) if f not in excluded_folds],
+            audit_floor=audit_floor,
+            quality_floor=fold_quality_floor,
+        )
+        result["tpu_secs_audit"] = (time.time() - t0) * mesh.size
+        result["num_sub_policies_dropped"] = len(audit["dropped"])
+        with open(os.path.join(save_dir, "audit.json"), "w") as fh:
+            json.dump(audit, fh, indent=1)
+
+    result["final_policy_set"] = final_policy_set
     result["num_sub_policies"] = len(final_policy_set)
 
     with open(os.path.join(save_dir, "final_policy.json"), "w") as fh:
@@ -319,3 +518,79 @@ def search_policies(
     )
     result["elapsed_total"] = time.time() - watch["start"]
     return result
+
+
+def audit_sub_policies(
+    evaluator: _FoldEval,
+    policy_set: list,
+    fold_paths: list[str],
+    *,
+    fold_baselines: dict[int, float],
+    candidate_folds: list[int],
+    audit_floor: float,
+    quality_floor: float | None = None,
+    num_draws_key: int = 23,
+) -> tuple[list, dict]:
+    """Drop sub-policies that standalone-degrade fold accuracy.
+
+    Each surviving sub-policy is scored ``mean_f[acc_f(sp)/base_f]``
+    over the audit folds, where ``acc_f(sp)`` uses the MEAN-over-draws
+    reduction (training applies one sub-policy per image — there is no
+    best-of-5 rescue at train time) and ``base_f`` is the fold's
+    identity-policy baseline.  Scores below `audit_floor` drop the
+    sub-policy.  The reference has no such step: its top-10 selection
+    inherits every trial's 5 sub-policies wholesale
+    (``search.py:255-259``), which is how round 2's destructive
+    policies survived.
+
+    Folds qualify for auditing when their checkpoint exists and their
+    baseline clears max(quality_floor, 2x chance).  Returns the kept
+    set and an audit record for ``audit.json``.
+    """
+    evaluator._build()
+    chance = 2.0 / evaluator.num_classes
+    floor = max(quality_floor or 0.0, chance)
+    audit_folds = []
+    for fold in candidate_folds:
+        path = fold_paths[fold]
+        if not os.path.exists(path):
+            continue
+        if fold not in fold_baselines:
+            fold_baselines[fold] = evaluator.baseline(fold, path)
+        if fold_baselines[fold] >= floor:
+            audit_folds.append(fold)
+    record: dict = {
+        "audit_floor": audit_floor,
+        "audit_folds": audit_folds,
+        "fold_baselines": {str(k): v for k, v in fold_baselines.items()},
+        "scores": [],
+        "dropped": [],
+    }
+    if not audit_folds:
+        logger.warning("audit: no fold passes the baseline floor %.3f — "
+                       "audit SKIPPED, policy set unchanged", floor)
+        return policy_set, record
+
+    loaded = {f: evaluator.load_fold(fold_paths[f]) for f in audit_folds}
+    kept = []
+    for i, sub in enumerate(policy_set):
+        sp_t = jnp.asarray(policy_to_tensor([list(map(tuple, sub))]))
+        ratios = []
+        for fold in audit_folds:
+            params, batch_stats = loaded[fold]
+            out = evaluator.evaluate(
+                fold, params, batch_stats, sp_t,
+                jax.random.PRNGKey(num_draws_key * 1000 + i),
+            )
+            ratios.append(out["top1_mean"] / max(fold_baselines[fold], 1e-6))
+        score = float(np.mean(ratios))
+        record["scores"].append({"sub_policy": sub, "score": score})
+        if score >= audit_floor:
+            kept.append(sub)
+        else:
+            record["dropped"].append({"sub_policy": sub, "score": score})
+    logger.info(
+        "audit: %d/%d sub-policies kept (floor %.2f x baseline over folds %s)",
+        len(kept), len(policy_set), audit_floor, audit_folds,
+    )
+    return kept, record

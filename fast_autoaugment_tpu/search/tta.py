@@ -69,11 +69,19 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
         # batch-global min loss over every (draw, sample) pair, masked
         nll_masked = jnp.where(mask[None, :] > 0, nll, jnp.inf)
         minus_loss = -jnp.min(nll_masked)
-        # per-sample best across draws
+        # per-sample best across draws (the reference's reward,
+        # search.py:116-125) — NOTE this is an optimistic reduction: a
+        # destructive sub-policy hides behind one benign draw
         correct_max = correct.any(axis=0) * (mask > 0)
+        # per-sample MEAN across draws: the pessimistic counterpart the
+        # sub-policy audit ranks by (what training-time application of
+        # the policy actually costs; round-2 post-mortem,
+        # docs/search_postmortem_r2.md)
+        correct_mean = correct.mean(axis=0) * (mask > 0)
         return {
             "minus_loss_sum": minus_loss,
             "correct_sum": correct_max.sum().astype(jnp.float32),
+            "correct_mean_sum": correct_mean.sum().astype(jnp.float32),
             "cnt": mask.sum().astype(jnp.float32),
         }
 
@@ -102,5 +110,6 @@ def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
     return {
         "minus_loss": acc["minus_loss_sum"] / cnt if cnt else 0.0,
         "top1_valid": acc["correct_sum"] / cnt if cnt else 0.0,
+        "top1_mean": acc["correct_mean_sum"] / cnt if cnt else 0.0,
         "cnt": cnt,
     }

@@ -307,6 +307,12 @@ def train_and_eval(
             state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
             acc.add_dict(metrics)
         train_metrics = acc.normalize()
+        if not train_metrics:
+            raise RuntimeError(
+                f"epoch {epoch} produced zero train batches "
+                f"({len(train_idx)} examples, global batch {global_batch}) — "
+                "feed pipeline bug or dataset/batch mismatch"
+            )
         if np.isnan(train_metrics["loss"]):
             raise RuntimeError("loss is NaN — training diverged (reference train.py:259)")
 

@@ -8,6 +8,37 @@ cluster, SURVEY.md section 4); this is the TPU-native answer.
 """
 
 import os
+import sys
+
+# The ambient sitecustomize loads the axon TPU-tunnel PJRT plugin into
+# EVERY interpreter at startup (gated on PALLAS_AXON_POOL_IPS) — before
+# this conftest can run.  Even with the factory deregistered below, the
+# loaded client library keeps background threads that SIGABRT the whole
+# process when the tunnel is dead (observed round 3: 'Fatal Python
+# error: Aborted' mid-eval under pytest while the identical clean-env
+# run passes).  The only full cure is to never load the plugin: re-exec
+# pytest once into a cleaned environment.  This must happen from
+# pytest_configure (below), NOT at conftest import: initial conftests
+# load inside pytest's fd-level global capture, so an exec here would
+# hand the child pytest capture tempfiles as stdout/stderr and the
+# whole run's output would vanish into an unlinked file.
+
+
+def pytest_configure(config):
+    if not os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get("_FAA_PYTEST_REEXEC"):
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:  # restore the real stdout/stderr fds pre-exec
+        capman.stop_global_capturing()
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["_FAA_PYTEST_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    args = list(config.invocation_params.args)
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + args, env)
+
 
 # Must run before any backend initializes.  The outer environment pins
 # JAX_PLATFORMS=axon (the single-chip TPU tunnel); tests must NOT use
@@ -17,6 +48,11 @@ import os
 # those children at all.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Synchronous host feed in tests: the prefetch worker's device_put races
+# the consumer's dispatch inside the CPU PJRT client and intermittently
+# aborts the process (see data/pipeline.py:prefetch).  Tests that
+# exercise the async worker itself override this locally.
+os.environ.setdefault("FAA_PREFETCH_SYNC", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

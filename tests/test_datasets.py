@@ -196,13 +196,15 @@ def test_eval_batches_single_process_pads_to_multiple():
     assert sum(int(m.sum()) for _, _, m in got) == 5
 
 
-def test_prefetch_transform_runs_in_worker_and_propagates_errors():
+def test_prefetch_transform_runs_in_worker_and_propagates_errors(monkeypatch):
     """prefetch(transform=) applies the mapping off the consumer thread
     and re-raises worker exceptions (including strict-zip arity errors
     from shard_transform) at the consumer."""
     import pytest
 
     from fast_autoaugment_tpu.data.pipeline import prefetch
+
+    monkeypatch.delenv("FAA_PREFETCH_SYNC", raising=False)  # async path
 
     items = [(np.ones((2, 2)), np.zeros(2)), (np.zeros((2, 2)), np.ones(2))]
     got = list(prefetch(iter(items), transform=lambda t: {"x": t[0], "y": t[1]}))
@@ -235,7 +237,7 @@ def test_shard_transform_arity_is_strict():
         )
 
 
-def test_prefetch_early_abandon_releases_worker():
+def test_prefetch_early_abandon_releases_worker(monkeypatch):
     """Breaking out of a prefetch loop (bench/eval early exit) must stop
     the worker thread rather than leave it blocked on a full queue
     holding buffered (possibly device-resident) batches."""
@@ -244,6 +246,7 @@ def test_prefetch_early_abandon_releases_worker():
 
     from fast_autoaugment_tpu.data.pipeline import prefetch
 
+    monkeypatch.delenv("FAA_PREFETCH_SYNC", raising=False)  # async path
     before = set(threading.enumerate())
     it = prefetch(iter(range(100)), depth=1)
     assert next(it) == 0

@@ -131,3 +131,107 @@ def test_devkit_cli(tmp_path, capsys):
     assert os.path.exists(tmp_path / "imagenet" / "val_cls.txt")
     out = capsys.readouterr().out
     assert "moved 6" in out and "wrote 6 entries" in out
+
+
+# ---------------------------------------------------------------------------
+# download/extract pipeline on fabricated tars + file:// URLs (reference
+# imagenet.py:164-231; VERDICT round 2, next-step 8)
+# ---------------------------------------------------------------------------
+
+
+def _make_tar(path, files, gzip=False):
+    """files: {member_name: bytes}"""
+    import io
+    import tarfile
+
+    mode = "w:gz" if gzip else "w"
+    with tarfile.open(path, mode) as tar:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+def test_fetch_verifies_and_skips_existing(tmp_path):
+    from fast_autoaugment_tpu.data.imagenet_tools import fetch, md5sum
+
+    src = tmp_path / "archive.bin"
+    src.write_bytes(b"payload")
+    md5 = md5sum(str(src))
+    dest = tmp_path / "downloads"
+
+    got = fetch(f"file://{src}", str(dest), md5=md5)
+    assert os.path.exists(got)
+    mtime = os.path.getmtime(got)
+    # second fetch: checksum matches -> no re-transfer
+    assert fetch(f"file://{src}", str(dest), md5=md5) == got
+    assert os.path.getmtime(got) == mtime
+
+    # corrupt target with a checksum -> re-fetched and repaired
+    with open(got, "wb") as fh:
+        fh.write(b"garbage")
+    assert fetch(f"file://{src}", str(dest), md5=md5) == got
+    assert md5sum(got) == md5
+
+    # upstream corruption -> loud failure
+    with pytest.raises(IOError, match="md5"):
+        fetch(f"file://{src}", str(dest), filename="other.bin", md5="0" * 32)
+
+
+def test_extract_tar_rejects_traversal(tmp_path):
+    from fast_autoaugment_tpu.data.imagenet_tools import extract_tar
+
+    bad = _make_tar(tmp_path / "evil.tar", {"../escape.txt": b"x"})
+    with pytest.raises(ValueError, match="unsafe"):
+        extract_tar(bad, str(tmp_path / "out"))
+
+
+def test_download_and_extract_train_expands_inner_tars(tmp_path):
+    """The train archive is a tar of per-class tars; download_and_extract
+    must fetch (file://), verify, extract, and expand each class tar into
+    its wnid folder (reference imagenet.py:101-131,224-226)."""
+    from fast_autoaugment_tpu.data.imagenet_tools import (
+        download_and_extract,
+        md5sum,
+        write_listfile,
+    )
+
+    inner_dir = tmp_path / "inner"
+    inner_dir.mkdir()
+    wnids = ["n01440764", "n01443537"]
+    inner_tars = {}
+    for w in wnids:
+        p = _make_tar(inner_dir / f"{w}.tar",
+                      {f"{w}_{i}.JPEG": b"img" for i in range(3)})
+        inner_tars[f"{w}.tar"] = open(p, "rb").read()
+    outer = _make_tar(tmp_path / "ILSVRC2012_img_train.tar", inner_tars)
+
+    root = tmp_path / "data"
+    dest = download_and_extract("train", str(root),
+                                url=f"file://{outer}", md5=md5sum(outer))
+    assert sorted(os.listdir(dest)) == wnids  # inner tars gone, dirs in place
+    for w in wnids:
+        assert len(os.listdir(os.path.join(dest, w))) == 3
+    # the expanded tree feeds the listfile generator (full offline chain)
+    n = write_listfile(dest, str(tmp_path / "train_cls.txt"))
+    assert n == 6
+
+
+def test_download_and_extract_devkit_gz(tmp_path):
+    from fast_autoaugment_tpu.data.imagenet_tools import (
+        download_and_extract,
+        md5sum,
+    )
+
+    gz = _make_tar(
+        tmp_path / "ILSVRC2012_devkit_t12.tar.gz",
+        {"ILSVRC2012_devkit_t12/data/ILSVRC2012_validation_ground_truth.txt":
+         b"1\n2\n"},
+        gzip=True,
+    )
+    root = tmp_path / "data"
+    dest = download_and_extract("devkit", str(root),
+                                url=f"file://{gz}", md5=md5sum(gz))
+    assert os.path.exists(os.path.join(
+        dest, "data", "ILSVRC2012_validation_ground_truth.txt"))

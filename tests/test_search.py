@@ -149,6 +149,68 @@ def test_smoke_search_end_to_end():
         assert result["tpu_secs_phase2"] > 0
 
 
+def test_audit_drops_destructive_keeps_benign(tmp_path):
+    """Round-2 regression gate (docs/search_postmortem_r2.md): the
+    sub-policy audit must drop policies that standalone-destroy fold
+    accuracy (Invert/Solarize-to-0 on a bright-glyph task) and keep
+    label-preserving ones (translate/near-identity brightness).  This is
+    the exact mechanism whose absence let the round-2 e2e search ship a
+    policy set that trained to random accuracy."""
+    from fast_autoaugment_tpu.core.config import Config
+    from fast_autoaugment_tpu.search.driver import (
+        _FoldEval,
+        _fold_ckpt_path,
+        audit_sub_policies,
+    )
+    from fast_autoaugment_tpu.train.trainer import train_and_eval
+
+    conf = Config({
+        "model": {"type": "wresnet10_1"},
+        "dataset": "synthetic_shapes",
+        "aug": "default",
+        "cutout": 0,
+        "batch": 2,  # global 16 on the 8-device mesh
+        "epoch": 20,
+        # conf lr is scaled by mesh.size (reference lr x world_size,
+        # train.py:117): 0.00625 x 8 = effective 0.05
+        "lr": 0.00625,
+        "lr_schedule": {"type": "cosine", "warmup": {"multiplier": 1, "epoch": 2}},
+        "optimizer": {"type": "sgd", "decay": 2e-4, "momentum": 0.9,
+                      "nesterov": True},
+    })
+    from fast_autoaugment_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    path = _fold_ckpt_path(str(tmp_path), conf, 0, 0.4)
+    train_and_eval(conf.replace(aug="default"), str(tmp_path), test_ratio=0.4,
+                   cv_fold=0, save_path=path, metric="last", seed=0)
+
+    ev = _FoldEval(conf, str(tmp_path), mesh,
+                   num_policy=5, num_op=2, cv_ratio=0.4, seed=0)
+    base = ev.baseline(0, path)
+    assert base > 0.5, f"fold oracle too weak to audit against ({base:.3f})"
+
+    benign = [
+        [("TranslateX", 0.5, 0.5), ("TranslateY", 0.5, 0.5)],
+        [("Brightness", 0.5, 0.55), ("Cutout", 0.3, 0.3)],
+    ]
+    destructive = [
+        # net polarity flips (NOT mutually-cancelling pairs: Invert+
+        # Solarize(0) would compose back to identity)
+        [("Invert", 1.0, 1.0), ("Cutout", 0.1, 0.1)],
+        # Solarize level 0 -> threshold 0 -> every pixel inverted;
+        # Brightness level 0.55 ~ factor 1.0 (identity)
+        [("Solarize", 1.0, 0.0), ("Brightness", 1.0, 0.55)],
+    ]
+    kept, audit = audit_sub_policies(
+        ev, benign + destructive, [path],
+        fold_baselines={0: base}, candidate_folds=[0], audit_floor=0.7,
+    )
+    scores = {i: s["score"] for i, s in enumerate(audit["scores"])}
+    assert all(b in kept for b in benign), scores
+    assert not any(d in kept for d in destructive), scores
+
+
 def test_tpe_beats_random_on_real_policy_space():
     """The 30-D mixed space benchmark (VERDICT round 1, weak 4): in-tree
     TPE must clearly outperform random search on a planted-policy reward.

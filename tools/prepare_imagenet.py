@@ -1,14 +1,18 @@
 """Prepare a local ILSVRC2012 tree for the framework.
 
-Subcommands (composable; reference ``imagenet.py:165-245`` capabilities,
-minus download — zero-egress deviation documented in docs/PARITY.md):
+Subcommands (composable; reference ``imagenet.py:6-19,164-245``
+capabilities):
 
+  download:   fetch + md5-verify + extract one release archive
+              (train expands its per-class inner tars; supports
+              --url mirrors incl. file://)
   val-reorg:  move the flat ``val/`` images into per-wnid folders using
               the devkit's meta.mat + ground-truth list
   listfile:   generate ``train_cls.txt`` / ``val_cls.txt`` (CLS-LOC
               format) so dataset loading skips the os.walk
   meta:       print the parsed synset table (sanity check)
 
+    python tools/prepare_imagenet.py download --split devkit --root /data
     python tools/prepare_imagenet.py val-reorg --root /data/imagenet \
         --devkit /data/ILSVRC2012_devkit_t12
     python tools/prepare_imagenet.py listfile --root /data/imagenet --split train
@@ -23,6 +27,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from fast_autoaugment_tpu.data.imagenet_tools import (  # noqa: E402
+    download_and_extract,
     parse_devkit,
     prepare_val_folder,
     write_listfile,
@@ -32,6 +37,14 @@ from fast_autoaugment_tpu.data.imagenet_tools import (  # noqa: E402
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    pd = sub.add_parser("download", help="fetch+verify+extract an archive")
+    pd.add_argument("--root", required=True)
+    pd.add_argument("--split", default="devkit",
+                    choices=["train", "val", "devkit"])
+    pd.add_argument("--url", default=None, help="mirror override (file:// ok)")
+    pd.add_argument("--md5", default=None,
+                    help="checksum override; empty string disables the check")
 
     pv = sub.add_parser("val-reorg", help="flat val/ -> per-wnid folders")
     pv.add_argument("--root", required=True, help="imagenet root (contains val/)")
@@ -45,7 +58,11 @@ def main(argv=None):
     pm.add_argument("--devkit", required=True)
 
     args = p.parse_args(argv)
-    if args.cmd == "val-reorg":
+    if args.cmd == "download":
+        dest = download_and_extract(args.split, args.root,
+                                    url=args.url, md5=args.md5)
+        print(f"extracted {args.split} -> {dest}")
+    elif args.cmd == "val-reorg":
         n = prepare_val_folder(os.path.join(args.root, "val"), args.devkit)
         print(f"moved {n} val images into wnid folders")
     elif args.cmd == "listfile":

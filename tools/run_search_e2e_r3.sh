@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-3 end-to-end search validation (VERDICT round 2, next-step 1).
+#
+# Runs the full 3-phase search on the glyph task with the round-3
+# selection guards enabled (fold-oracle quality gate, longer phase-1
+# pretraining, per-sub-policy audit) and an accuracy-headroom-calibrated
+# train-set size.  MUST run on the real TPU chip (ambient env); takes
+# roughly an hour.  Artifacts land in search_e2e_r3/ (summary JSONs are
+# committed; bulk outputs are gitignored).
+#
+#   bash tools/run_search_e2e_r3.sh [dataset] [save_dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATASET="${1:-synthetic_shapes_n120}"
+SAVE="${2:-search_e2e_r3}"
+
+python -m fast_autoaugment_tpu.launch.search_cli \
+    -c confs/wresnet10x1_shapes_hard.yaml \
+    --dataroot ./data \
+    --save-dir "$SAVE" \
+    --num-search 100 \
+    --num-top 10 \
+    --seed 1 \
+    --fold-quality-floor 0.60 \
+    --fold-retrain-tries 2 \
+    --phase1-epochs 200 \
+    --audit-floor 0.7 \
+    "dataset=$DATASET" \
+    2>&1 | tee "$SAVE.log"
