@@ -495,12 +495,26 @@ def search_policies(
     # ---------------- phase 2.5: per-sub-policy audit -----------------
     if audit_floor is not None and final_policy_set:
         t0 = time.time()
+        # audit scores are floor-independent (per-sub-policy accuracy
+        # ratios vs fixed fold checkpoints): hand a previous run's
+        # audit.json to the audit, which reuses it only after verifying
+        # the audit fold set AND their baselines are unchanged (both are
+        # only known inside, after the lazy baseline fill)
+        cached_audit = None
+        audit_path = os.path.join(save_dir, "audit.json")
+        if resume and os.path.exists(audit_path):
+            try:
+                with open(audit_path) as fh:
+                    cached_audit = json.load(fh)
+            except (OSError, ValueError):
+                cached_audit = None
         final_policy_set, audit = audit_sub_policies(
             evaluator, final_policy_set, fold_paths,
             fold_baselines=fold_baselines,
             candidate_folds=[f for f in range(cv_num) if f not in excluded_folds],
             audit_floor=audit_floor,
             quality_floor=fold_quality_floor,
+            cached_audit=cached_audit,
         )
         result["tpu_secs_audit"] = (time.time() - t0) * mesh.size
         result["num_sub_policies_dropped"] = len(audit["dropped"])
@@ -530,6 +544,7 @@ def audit_sub_policies(
     audit_floor: float,
     quality_floor: float | None = None,
     num_draws_key: int = 23,
+    cached_audit: dict | None = None,
 ) -> tuple[list, dict]:
     """Drop sub-policies that standalone-degrade fold accuracy.
 
@@ -571,19 +586,50 @@ def audit_sub_policies(
                        "audit SKIPPED, policy set unchanged", floor)
         return policy_set, record
 
-    loaded = {f: evaluator.load_fold(fold_paths[f]) for f in audit_folds}
+    # cached-score validity: the old run must have audited the SAME fold
+    # set with the SAME baselines — scores are means over audit folds,
+    # so a changed fold set silently changes every score's meaning
+    cached_scores: dict = {}
+    if cached_audit:
+        try:
+            same_folds = list(cached_audit.get("audit_folds", [])) == audit_folds
+            same_base = same_folds and all(
+                abs(cached_audit["fold_baselines"].get(str(f), -1.0)
+                    - fold_baselines[f]) < 1e-6
+                for f in audit_folds
+            )
+            if same_base and cached_audit.get("scores"):
+                cached_scores = {
+                    json.dumps(s["sub_policy"]): s["score"]
+                    for s in cached_audit["scores"]
+                }
+                logger.info("audit: reusing %d cached scores", len(cached_scores))
+            else:
+                logger.info("audit: cached scores stale (fold set or "
+                            "baselines changed) — recomputing")
+        except (KeyError, TypeError, ValueError):
+            cached_scores = {}
+
+    loaded = None
     kept = []
     for i, sub in enumerate(policy_set):
-        sp_t = jnp.asarray(policy_to_tensor([list(map(tuple, sub))]))
-        ratios = []
-        for fold in audit_folds:
-            params, batch_stats = loaded[fold]
-            out = evaluator.evaluate(
-                fold, params, batch_stats, sp_t,
-                jax.random.PRNGKey(num_draws_key * 1000 + i),
-            )
-            ratios.append(out["top1_mean"] / max(fold_baselines[fold], 1e-6))
-        score = float(np.mean(ratios))
+        cache_key = json.dumps(sub)
+        if cache_key in cached_scores:
+            score = float(cached_scores[cache_key])
+        else:
+            if loaded is None:
+                loaded = {f: evaluator.load_fold(fold_paths[f])
+                          for f in audit_folds}
+            sp_t = jnp.asarray(policy_to_tensor([list(map(tuple, sub))]))
+            ratios = []
+            for fold in audit_folds:
+                params, batch_stats = loaded[fold]
+                out = evaluator.evaluate(
+                    fold, params, batch_stats, sp_t,
+                    jax.random.PRNGKey(num_draws_key * 1000 + i),
+                )
+                ratios.append(out["top1_mean"] / max(fold_baselines[fold], 1e-6))
+            score = float(np.mean(ratios))
         record["scores"].append({"sub_policy": sub, "score": score})
         if score >= audit_floor:
             kept.append(sub)
