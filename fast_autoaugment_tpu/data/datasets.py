@@ -184,7 +184,8 @@ def _load_imagenet_listing(dataroot: str, split: str) -> ArrayDataset:
 
 def _synthetic_shapes(n_train: int = 600, n_test: int = 2000, size: int = 32,
                       noise: float = 12.0, fg_lo: float = 60.0,
-                      fg_hi: float = 130.0):
+                      fg_hi: float = 130.0, max_rot: float = 0.0,
+                      scale_lo: float = 1.0, scale_hi: float = 1.0):
     """Structured 10-class glyph dataset for end-to-end search validation.
 
     Each class is a fixed 12x12 binary glyph; every sample renders it at
@@ -203,6 +204,16 @@ def _synthetic_shapes(n_train: int = 600, n_test: int = 2000, size: int = 32,
     variant at 100% test.  The `noise`/`fg_lo`/`fg_hi` knobs grade
     difficulty further (lower glyph contrast or a higher noise floor
     make the task unlearnably hard well before 15/class does).
+
+    `max_rot` (degrees) / `scale_lo..scale_hi` add per-sample POSE
+    variation (the ``synthetic_shapes_pose*`` variants): unlike
+    position, pose is NOT covered by the default crop+flip transform
+    stack, so a small train set undersamples it and the model can only
+    recover the invariance through augmentation — the regime where the
+    reference's searched policies (Rotate/Shear/Translate live in the
+    op vocabulary) genuinely pay, and the round-3 e2e validation's
+    fix for default-aug saturating the position-only task at
+    convergence (docs/search_postmortem_r2.md).
     """
     glyph_rng = np.random.default_rng(7)
     glyphs = (glyph_rng.uniform(size=(10, 12, 12)) < 0.45).astype(np.float32)
@@ -215,9 +226,27 @@ def _synthetic_shapes(n_train: int = 600, n_test: int = 2000, size: int = 32,
             bg = rng.uniform(30, 120)
             fg = bg + rng.uniform(fg_lo, fg_hi)
             contrast = rng.uniform(0.7, 1.3)
+            glyph = glyphs[lb]
+            if max_rot or scale_lo != 1.0 or scale_hi != 1.0:
+                # inverse-map affine (nearest): rotate by theta, scale s
+                theta = np.deg2rad(rng.uniform(-max_rot, max_rot))
+                s = rng.uniform(scale_lo, scale_hi)
+                g = 12
+                out_px = int(round(g * max(s, 1.0))) + 4
+                yy, xx = np.mgrid[0:out_px, 0:out_px].astype(np.float32)
+                cy = cx = (out_px - 1) / 2.0
+                co, si = np.cos(theta), np.sin(theta)
+                ys = (co * (yy - cy) + si * (xx - cx)) / s + (g - 1) / 2.0
+                xs = (-si * (yy - cy) + co * (xx - cx)) / s + (g - 1) / 2.0
+                yi = np.clip(np.round(ys).astype(int), 0, g - 1)
+                xi = np.clip(np.round(xs).astype(int), 0, g - 1)
+                inside = (ys >= -0.5) & (ys <= g - 0.5) & (xs >= -0.5) & (xs <= g - 0.5)
+                glyph = np.where(inside, glyphs[lb][yi, xi], 0.0).astype(np.float32)
+            gh, gw = glyph.shape
             canvas = np.full((size, size), bg, np.float32)
-            y, x = rng.integers(0, size - 12, 2)
-            canvas[y:y + 12, x:x + 12] += glyphs[lb] * (fg - bg)
+            y = rng.integers(0, max(size - gh, 1))
+            x = rng.integers(0, max(size - gw, 1))
+            canvas[y:y + gh, x:x + gw] += glyph * (fg - bg)
             canvas = (canvas - canvas.mean()) * contrast + canvas.mean()
             canvas = canvas + rng.normal(0, noise, (size, size))
             images[i] = np.clip(canvas, 0, 255)[..., None].astype(np.uint8)
@@ -313,6 +342,17 @@ def load_dataset(dataset: str, dataroot: str):
         # samples, render unchanged): the difficulty dial for grading
         # search-validation headroom (docs/search_postmortem_r2.md #4)
         return _synthetic_shapes(n_train=int(dataset.rsplit("n", 1)[1]))
+    if dataset.startswith("synthetic_shapes_pose"):
+        # pose-varying variant (rotation +-25deg, scale 0.7-1.3) with a
+        # parametrized train size (synthetic_shapes_pose200 -> 200):
+        # pose is the one variation default crop+flip cannot cover, so
+        # augmentation (Rotate/Shear/Translate in the op vocabulary) is
+        # the only route to the invariance at small n
+        suffix = dataset[len("synthetic_shapes_pose"):]
+        return _synthetic_shapes(
+            n_train=int(suffix) if suffix else 200,
+            max_rot=25.0, scale_lo=0.7, scale_hi=1.3,
+        )
     if dataset.startswith("synthetic"):
         # synthetic / synthetic_cifar100-style names for tests and benches
         num_classes = 100 if dataset.endswith("100") else 10

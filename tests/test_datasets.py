@@ -271,3 +271,23 @@ def test_synthetic_shapes_difficulty_knobs():
     diff = (noisy_train.images.astype(np.float32)
             - clean_train.images.astype(np.float32))
     assert np.abs(diff).mean() > 10.0, "higher noise floor must perturb pixels"
+
+
+def test_synthetic_shapes_pose_variant():
+    """The pose variant must actually vary pose: per-sample rotation and
+    scale change the glyph footprint in ways the base render never does,
+    and the registry name parametrizes train size."""
+    from fast_autoaugment_tpu.data.datasets import _synthetic_shapes, load_dataset
+
+    base_train, _ = _synthetic_shapes(n_train=64, n_test=1)
+    pose_train, _ = _synthetic_shapes(n_train=64, n_test=1, max_rot=25.0,
+                                      scale_lo=0.7, scale_hi=1.3)
+    assert pose_train.images.shape == base_train.images.shape
+    # same label stream (same seed), different rendered pixels
+    np.testing.assert_array_equal(pose_train.labels, base_train.labels)
+    diff = (pose_train.images.astype(np.int32)
+            - base_train.images.astype(np.int32))
+    assert np.abs(diff).mean() > 2.0, "pose knobs changed nothing"
+
+    train, test = load_dataset("synthetic_shapes_pose300", dataroot="")
+    assert len(train) == 300 and train.num_classes == 10 and len(test) == 2000
