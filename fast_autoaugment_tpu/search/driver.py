@@ -57,7 +57,11 @@ from fast_autoaugment_tpu.policies.archive import (
     remove_duplicates,
 )
 from fast_autoaugment_tpu.search.tpe import TPE, choice, uniform
-from fast_autoaugment_tpu.search.tta import eval_tta, make_tta_step
+from fast_autoaugment_tpu.search.tta import (
+    eval_tta,
+    make_audit_step,
+    make_tta_step,
+)
 from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import get_logger
 
@@ -163,6 +167,11 @@ class _FoldEval:
             model, num_policy=self.num_policy, cutout_length=cutout_length,
             augment_fn=tta_augment_fn,
         )
+        # jit wrapping is free; XLA compiles at the first audit_eval call
+        self.audit_step = make_audit_step(
+            model, num_policy=self.num_policy, cutout_length=cutout_length,
+            augment_fn=tta_augment_fn,
+        )
 
         # checkpoint template, built once (models are input-size-polymorphic
         # after init, but use the real resolution for clarity)
@@ -224,6 +233,13 @@ class _FoldEval:
             self.tta_step, params, batch_stats, self.batches_fn(fold)(),
             policy_t, key,
         )
+
+    def audit_eval(self, params, batch_stats, batch, subs, key) -> dict:
+        """Batched audit: S sub-policies against one mesh-placed batch
+        in a single compiled call (``make_audit_step``)."""
+        self._build()
+        return self.audit_step(params, batch_stats, batch["x"], batch["y"],
+                               batch["m"], subs, key)
 
     def baseline(self, fold: int, path: str) -> float:
         """No-candidate-policy fold accuracy: the identity policy (one
@@ -545,6 +561,7 @@ def audit_sub_policies(
     quality_floor: float | None = None,
     num_draws_key: int = 23,
     cached_audit: dict | None = None,
+    audit_chunk: int | None = None,
 ) -> tuple[list, dict]:
     """Drop sub-policies that standalone-degrade fold accuracy.
 
@@ -610,26 +627,76 @@ def audit_sub_policies(
         except (KeyError, TypeError, ValueError):
             cached_scores = {}
 
-    loaded = None
+    # evaluate the non-cached sub-policies in CHUNKS of `audit_chunk`
+    # per compiled call (make_audit_step): the sub-policy axis is a
+    # vmap, so one dispatch covers chunk x draws x batch images — the
+    # MXU-shaped layout — instead of one tiny launch per (sub-policy,
+    # batch).  The last chunk pads to the fixed size (no recompiles).
+    idx_to_eval = [i for i, sub in enumerate(policy_set)
+                   if json.dumps(sub) not in cached_scores]
+    computed: dict[int, float] = {}
+    if idx_to_eval and len(idx_to_eval) <= 4:
+        # tiny audits (tests, smoke runs): the already-compiled TTA step
+        # beats paying a fresh audit-step compile
+        loaded = {f: evaluator.load_fold(fold_paths[f]) for f in audit_folds}
+        for i in idx_to_eval:
+            sp_t = jnp.asarray(policy_to_tensor([list(map(tuple, policy_set[i]))]))
+            ratios = [
+                evaluator.evaluate(
+                    fold, *loaded[fold], sp_t,
+                    jax.random.PRNGKey(num_draws_key * 1000 + i),
+                )["top1_mean"] / max(fold_baselines[fold], 1e-6)
+                for fold in audit_folds
+            ]
+            computed[i] = float(np.mean(ratios))
+    elif idx_to_eval:
+        loaded = {f: evaluator.load_fold(fold_paths[f]) for f in audit_folds}
+        if audit_chunk is None:
+            # peak memory scales with chunk x image^2: 8 at CIFAR
+            # resolution, 1 at ImageNet's 224px (same footprint as the
+            # TTA step either way)
+            audit_chunk = max(1, (8 * 32 * 32) // (evaluator.image ** 2))
+        chunk = max(1, int(audit_chunk))
+        n = len(idx_to_eval)
+        subs_np = np.stack([
+            np.asarray(policy_to_tensor([list(map(tuple, policy_set[i]))]),
+                       np.float32)[0]
+            for i in idx_to_eval
+        ])  # [n, num_op, 3]
+        ratio_sums = np.zeros(n)
+        for fold in audit_folds:
+            params, batch_stats = loaded[fold]
+            sums = np.zeros(n)
+            cnt = 0.0
+            for start in range(0, n, chunk):
+                block = subs_np[start:start + chunk]
+                real = len(block)
+                if real < chunk:
+                    block = np.concatenate(
+                        [block,
+                         np.zeros((chunk - real,) + block.shape[1:], np.float32)])
+                bsum = np.zeros(chunk)
+                bcnt = 0.0
+                for bi, batch in enumerate(evaluator.batches_fn(fold)()):
+                    out = evaluator.audit_eval(
+                        params, batch_stats, batch, jnp.asarray(block),
+                        jax.random.fold_in(
+                            jax.random.PRNGKey(num_draws_key),
+                            fold * 100003 + start * 131 + bi),
+                    )
+                    bsum += np.asarray(out["correct_mean_sum"])
+                    bcnt += float(out["cnt"])
+                sums[start:start + real] = bsum[:real]
+                cnt = bcnt
+            ratio_sums += (sums / max(cnt, 1e-6)) / max(fold_baselines[fold], 1e-6)
+        for j, i in enumerate(idx_to_eval):
+            computed[i] = float(ratio_sums[j] / len(audit_folds))
+
     kept = []
     for i, sub in enumerate(policy_set):
         cache_key = json.dumps(sub)
-        if cache_key in cached_scores:
-            score = float(cached_scores[cache_key])
-        else:
-            if loaded is None:
-                loaded = {f: evaluator.load_fold(fold_paths[f])
-                          for f in audit_folds}
-            sp_t = jnp.asarray(policy_to_tensor([list(map(tuple, sub))]))
-            ratios = []
-            for fold in audit_folds:
-                params, batch_stats = loaded[fold]
-                out = evaluator.evaluate(
-                    fold, params, batch_stats, sp_t,
-                    jax.random.PRNGKey(num_draws_key * 1000 + i),
-                )
-                ratios.append(out["top1_mean"] / max(fold_baselines[fold], 1e-6))
-            score = float(np.mean(ratios))
+        score = (float(cached_scores[cache_key])
+                 if cache_key in cached_scores else computed[i])
         record["scores"].append({"sub_policy": sub, "score": score})
         if score >= audit_floor:
             kept.append(sub)

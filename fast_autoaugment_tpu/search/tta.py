@@ -31,7 +31,15 @@ import jax.numpy as jnp
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
 
-__all__ = ["make_tta_step", "eval_tta"]
+__all__ = ["make_tta_step", "make_audit_step", "eval_tta"]
+
+
+def _default_augment_fn(cutout_length: int) -> Callable:
+    """CIFAR-family train stack (crop/flip/normalize + policy + cutout)."""
+    def augment_fn(images, policy, key):
+        return cifar_train_batch(images, key, policy=policy,
+                                 cutout_length=cutout_length)
+    return augment_fn
 
 
 def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
@@ -44,9 +52,7 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
     independent randomness.
     """
     if augment_fn is None:
-        def augment_fn(images, policy, key):
-            return cifar_train_batch(images, key, policy=policy,
-                                     cutout_length=cutout_length)
+        augment_fn = _default_augment_fn(cutout_length)
 
     @jax.jit
     def tta_step(params, batch_stats, images, labels, mask, policy, key):
@@ -86,6 +92,50 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
         }
 
     return tta_step
+
+
+def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
+                    augment_fn: Callable | None = None):
+    """Batched sub-policy audit step: evaluates S candidate sub-policies
+    against one batch in ONE compiled call.
+
+    The per-sub-policy audit (``search/driver.py:audit_sub_policies``)
+    needs mean-over-draws accuracy for EVERY selected sub-policy alone;
+    done with :func:`make_tta_step` that is one tiny dispatch per
+    (sub-policy, batch) — thousands of launches that starve the MXU.
+    Here the sub-policy axis is a vmap: ``subs`` is [S, num_op, 3] and
+    the model forward runs on the S*P*B flattened batch.  Returns
+    ``fn(params, batch_stats, images, labels, mask, subs, key) ->
+    {"correct_mean_sum": [S], "cnt": scalar}``.  NOTE peak memory is S x
+    the TTA step's (the [S, P, B, H, W, C] augmented tensor) — callers
+    size S by image resolution (``audit_sub_policies``).
+    """
+    if augment_fn is None:
+        augment_fn = _default_augment_fn(cutout_length)
+
+    @jax.jit
+    def audit_step(params, batch_stats, images, labels, mask, subs, key):
+        s = subs.shape[0]
+        keys = jax.random.split(key, s * num_policy).reshape(s, num_policy, 2)
+
+        def per_sub(sub, ks):
+            # a [1, num_op, 3] policy: every draw applies this sub-policy
+            return jax.vmap(lambda k: augment_fn(images, sub[None], k))(ks)
+
+        augmented = jax.vmap(per_sub)(subs, keys)  # [S, P, B, H, W, C]
+        p, b = augmented.shape[1], augmented.shape[2]
+        flat = augmented.reshape((s * p * b,) + augmented.shape[3:])
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, flat, train=False
+        ).reshape(s, p, b, -1)
+        correct = (jnp.argmax(logits, axis=-1) == labels[None, None, :])
+        correct_mean = correct.mean(axis=1) * (mask[None, :] > 0)  # [S, B]
+        return {
+            "correct_mean_sum": correct_mean.sum(axis=1).astype(jnp.float32),
+            "cnt": mask.sum().astype(jnp.float32),
+        }
+
+    return audit_step
 
 
 def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:

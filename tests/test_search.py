@@ -193,6 +193,9 @@ def test_audit_drops_destructive_keeps_benign(tmp_path):
     benign = [
         [("TranslateX", 0.5, 0.5), ("TranslateY", 0.5, 0.5)],
         [("Brightness", 0.5, 0.55), ("Cutout", 0.3, 0.3)],
+        # 5 candidates total: forces the CHUNKED batched audit step
+        # (make_audit_step), not the small-n fallback
+        [("ShearX", 0.3, 0.5), ("Sharpness", 0.3, 0.5)],
     ]
     destructive = [
         # net polarity flips (NOT mutually-cancelling pairs: Invert+
