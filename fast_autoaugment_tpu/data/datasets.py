@@ -232,10 +232,13 @@ def _synthetic_shapes(n_train: int = 600, n_test: int = 2000, size: int = 32,
                 theta = np.deg2rad(rng.uniform(-max_rot, max_rot))
                 s = rng.uniform(scale_lo, scale_hi)
                 g = 12
-                out_px = int(round(g * max(s, 1.0))) + 4
+                co, si = np.cos(theta), np.sin(theta)
+                # canvas sized to the rotated bounding box (+2 guard px):
+                # a fixed round(g*s)+4 clips glyph corners at high
+                # rotation x scale, eroding label signal (ADVICE r3)
+                out_px = int(np.ceil(g * max(s, 1.0) * (abs(co) + abs(si)))) + 2
                 yy, xx = np.mgrid[0:out_px, 0:out_px].astype(np.float32)
                 cy = cx = (out_px - 1) / 2.0
-                co, si = np.cos(theta), np.sin(theta)
                 ys = (co * (yy - cy) + si * (xx - cx)) / s + (g - 1) / 2.0
                 xs = (-si * (yy - cy) + co * (xx - cx)) / s + (g - 1) / 2.0
                 yi = np.clip(np.round(ys).astype(int), 0, g - 1)

@@ -86,9 +86,16 @@ def fetch(url: str, dest_dir: str, *, filename: str | None = None,
     target = os.path.join(dest_dir, filename)
     if os.path.exists(target) and (md5 is None or md5sum(target) == md5):
         return target
-    urllib.request.urlretrieve(url, target)
-    if md5 is not None and (got := md5sum(target)) != md5:
+    # download to a .part temp and rename only after the checksum
+    # passes: a download interrupted mid-transfer can never leave a
+    # truncated file at `target` that a later md5=None call silently
+    # accepts (ADVICE r3)
+    part = target + ".part"
+    urllib.request.urlretrieve(url, part)
+    if md5 is not None and (got := md5sum(part)) != md5:
+        os.remove(part)
         raise IOError(f"{target}: md5 {got} != expected {md5} — corrupt download")
+    os.replace(part, target)
     return target
 
 
@@ -102,7 +109,8 @@ def extract_tar(src: str, dest: str | None = None, *, gzip: bool | None = None,
         gzip = src.lower().endswith(".gz")
     os.makedirs(dest, exist_ok=True)
     with tarfile.open(src, "r:gz" if gzip else "r") as tar:
-        for member in tar.getmembers():
+        members = tar.getmembers()
+        for member in members:
             name = member.name
             if os.path.isabs(name) or ".." in name.split("/"):
                 raise ValueError(f"{src}: unsafe member path {name!r}")
@@ -112,10 +120,17 @@ def extract_tar(src: str, dest: str | None = None, *, gzip: bool | None = None,
                     raise ValueError(
                         f"{src}: unsafe link member {name!r} -> {link!r}"
                     )
+            # filter='data' also rejects special members (FIFOs, device
+            # nodes) and strips setuid/setgid bits; mirror both on the
+            # pre-3.12 fallback path (ADVICE r3)
+            if not (member.isfile() or member.isdir()
+                    or member.issym() or member.islnk()):
+                raise ValueError(f"{src}: special member {name!r} refused")
+            member.mode &= 0o777  # drop setuid/setgid/sticky
         try:
             tar.extractall(dest, filter="data")  # py>=3.12 semantics
         except TypeError:  # older tarfile without the filter kwarg;
-            tar.extractall(dest)  # manual name+link checks above apply
+            tar.extractall(dest, members=members)  # checks above apply
     if delete:
         os.remove(src)
     return dest

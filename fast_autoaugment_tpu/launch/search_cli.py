@@ -45,18 +45,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--smoke-test", action="store_true")
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--fold-quality-floor", type=float, default=None,
+    p.add_argument("--fold-quality-floor", default="auto",
                    help="fold-oracle gate: retrain (fresh seed) folds whose "
                         "no-policy baseline accuracy is below this, exclude "
-                        "them from ranking if still weak (None disables; "
-                        "docs/search_postmortem_r2.md)")
+                        "them from ranking if still weak.  'auto' (default) "
+                        "= chance + 0.35*(1-chance); a float sets it "
+                        "explicitly; 'off' disables "
+                        "(docs/search_postmortem_r2.md)")
     p.add_argument("--fold-retrain-tries", type=int, default=2)
     p.add_argument("--phase1-epochs", type=int, default=None,
                    help="override conf['epoch'] for phase-1 fold pretraining")
-    p.add_argument("--audit-floor", type=float, default=0.7,
+    p.add_argument("--audit-floor", type=float, default=0.95,
                    help="drop selected sub-policies whose standalone "
                         "mean-over-draws fold accuracy < floor x baseline "
-                        "(<=0 disables)")
+                        "(<=0 disables).  Default 0.95: the validated "
+                        "round-3 recipe — the old 0.7 default measurably "
+                        "ships destructive policies "
+                        "(search_e2e_r3/search_result_floor0.70.json)")
     p.add_argument("override", nargs="*")
     return p
 
@@ -97,8 +102,12 @@ def main(argv=None):
         return result
 
     if args.until >= 3:
-        # phase 3: full retrains default vs augmented (search.py:264-312)
+        # phase 3: full retrains default vs augmented (search.py:264-312).
+        # Unlike the reference's bare means, record per-seed values, the
+        # spread and a paired t-test (runs pair by seed: identical data
+        # and init, only the augmentation differs) — VERDICT r3, next-4
         num_runs = 1 if args.smoke_test else args.num_result_per_cv
+        seeds = [args.seed + run for run in range(num_runs)]
         outcomes = {"default": [], "augment": []}
         for mode, aug in (("default", "default"), ("augment", final_policy_set)):
             for run in range(num_runs):
@@ -106,16 +115,34 @@ def main(argv=None):
                 path = f"{args.save_dir}/final_{mode}_{run}.msgpack"
                 res = train_and_eval(
                     mode_conf, args.dataroot, test_ratio=0.0,
-                    save_path=path, metric="last", seed=args.seed + run,
+                    save_path=path, metric="last", seed=seeds[run],
                 )
-                outcomes[mode].append(res.get("top1_test", 0.0))
+                outcomes[mode].append(float(res.get("top1_test", 0.0)))
                 logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,
                             outcomes[mode][-1])
         result["top1_test_default_mean"] = float(np.mean(outcomes["default"]))
         result["top1_test_augment_mean"] = float(np.mean(outcomes["augment"]))
+        phase3 = {"num_runs": num_runs, "seeds": seeds}
+        for mode in ("default", "augment"):
+            vals = outcomes[mode]
+            phase3[mode] = {
+                "per_seed": vals,
+                "mean": float(np.mean(vals)),
+                "std": float(np.std(vals, ddof=1)) if len(vals) > 1 else 0.0,
+            }
+        if num_runs > 1:
+            from fast_autoaugment_tpu.utils.stats import paired_t_test
+
+            phase3["paired_augment_minus_default"] = paired_t_test(
+                outcomes["augment"], outcomes["default"]
+            )
+        result["phase3"] = phase3
         logger.info(
-            "phase3: default %.4f vs augmented %.4f",
-            result["top1_test_default_mean"], result["top1_test_augment_mean"],
+            "phase3: default %.4f±%.4f vs augmented %.4f±%.4f (n=%d%s)",
+            phase3["default"]["mean"], phase3["default"]["std"],
+            phase3["augment"]["mean"], phase3["augment"]["std"], num_runs,
+            ", paired p=%.3f" % phase3["paired_augment_minus_default"]["p_value"]
+            if num_runs > 1 else "",
         )
 
     import jax

@@ -6,8 +6,9 @@ places (WideResNet's custom ``conv_init`` is commented out,
 (``resnet.py:126-132``).  Those distributions affect reproducibility,
 so both are provided here explicitly:
 
-- :data:`torch_default_kernel` / :func:`torch_default_bias` — PyTorch's
-  kaiming-uniform(a=sqrt 5) conv/linear default: U(+-1/sqrt(fan_in)).
+- :data:`torch_default_kernel` / :func:`torch_default_bias_for` —
+  PyTorch's kaiming-uniform(a=sqrt 5) conv/linear default:
+  U(+-1/sqrt(fan_in)).
 - :data:`he_normal_fanout` — N(0, sqrt(2 / (k*k*c_out))).
 
 All modules run NHWC, the TPU-native layout.  BatchNorm momentum
@@ -28,7 +29,7 @@ from flax import linen as nn
 
 __all__ = [
     "torch_default_kernel",
-    "torch_default_bias",
+    "torch_default_bias_for",
     "he_normal_fanout",
     "BatchNorm",
     "global_avg_pool",
@@ -41,17 +42,12 @@ def torch_default_kernel(dtype=jnp.float32):
     return jax.nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform", dtype=dtype)
 
 
-def torch_default_bias(dtype=jnp.float32) -> Callable:
+def torch_default_bias_for(fan_in: int, dtype=jnp.float32) -> Callable:
     """PyTorch default bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
 
-    flax bias initializers don't see fan_in, so this returns a closure
-    factory: call with the matching kernel shape convention at module
-    build time via `bias_init=torch_default_bias_for(fan_in)`.
+    flax bias initializers don't see fan_in, so the caller supplies it
+    at module build time (``bias_init=torch_default_bias_for(fan_in)``).
     """
-    raise NotImplementedError("use torch_default_bias_for(fan_in)")
-
-
-def torch_default_bias_for(fan_in: int, dtype=jnp.float32) -> Callable:
     bound = 1.0 / np.sqrt(fan_in) if fan_in > 0 else 0.0
 
     def init(key, shape, dtype=dtype):

@@ -65,9 +65,43 @@ from fast_autoaugment_tpu.search.tta import (
 from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import get_logger
 
-__all__ = ["search_policies", "make_search_space", "SearchResult"]
+__all__ = ["search_policies", "make_search_space", "SearchResult",
+           "resolve_quality_floor"]
 
 logger = get_logger("faa_tpu.search")
+
+
+def resolve_quality_floor(floor, num_classes: int) -> float | None:
+    """Resolve the fold-oracle quality floor.
+
+    ``"auto"`` (the CLI default since round 4) is chance-relative: the
+    fold baseline must close at least 35% of the chance-to-perfect gap,
+    ``chance + 0.35 * (1 - chance)`` — 0.415 on a 10-class task, in line
+    with the validated 0.45 recipe (docs/search_postmortem_r2.md) while
+    scaling to any class count.  Floats pass through; ``None``/``"off"``
+    or a non-positive value disables the gate (the pre-round-4
+    behavior, which ships the round-2 failure mode — see VERDICT r3)."""
+    if floor is None:
+        return None
+    if isinstance(floor, str):
+        if floor == "auto":
+            chance = 1.0 / num_classes
+            return chance + 0.35 * (1.0 - chance)
+        if floor.lower() in ("off", "none"):
+            return None
+        floor = float(floor)
+    return floor if floor > 0 else None
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    """fsync-then-rename write: a crash mid-write can never tear the
+    file, and a crash right after loses nothing (VERDICT r3, weak 4)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def make_search_space(num_policy: int, num_op: int):
@@ -212,9 +246,14 @@ class _FoldEval:
         )
 
         def _stream():
+            # pad the final batch to FULL size (not just the mesh
+            # multiple): every batch then has one static shape, so the
+            # TTA/audit executables never fork on the remainder batch —
+            # one compile serves the entire search (the masks already
+            # carry correctness; the waste is <1 batch per fold epoch)
             return fold_it.eval_epoch(
                 batch, process_index=jax.process_index(),
-                process_count=jax.process_count(), pad_multiple=self.mesh.size,
+                process_count=jax.process_count(), pad_multiple=batch,
             )
 
         _to_device = shard_transform(self.mesh, ("x", "y", "m"))
@@ -281,7 +320,9 @@ def search_policies(
     `train_fold_fn(conf, fold, save_path)` overrides phase-1 training
     (the launcher passes a multi-host scatter; default trains in-process
     sequentially, the single-host analog of the reference's Ray scatter,
-    ``search.py:170-206``).
+    ``search.py:170-206``).  Quality-gate retrains route through the
+    same override; the fresh retry seed arrives as ``conf['seed']``,
+    which implementations should forward to their trainer.
 
     `folds` restricts BOTH phases to a subset of fold indices — the
     scatter unit for running the search across machines (host k runs
@@ -315,10 +356,21 @@ def search_policies(
     if smoke_test:  # reference --smoke-test (search.py:153, 235)
         num_search = 4
 
+    fold_quality_floor = resolve_quality_floor(
+        fold_quality_floor, num_class(conf["dataset"])
+    )
     os.makedirs(save_dir, exist_ok=True)
     mesh = make_mesh()
     watch = {"start": time.time()}
     result = SearchResult()
+    # the guard settings this run actually used — the defaults-safety
+    # regression test reads these back from the committed artifact
+    result["guards"] = {
+        "fold_quality_floor": fold_quality_floor,
+        "fold_retrain_tries": fold_retrain_tries,
+        "audit_floor": audit_floor,
+        "phase1_epochs": phase1_epochs,
+    }
     fold_list = list(folds) if folds is not None else list(range(cv_num))
     bad = [f for f in fold_list if not 0 <= f < cv_num]
     if bad:
@@ -405,10 +457,20 @@ def search_policies(
                 fold, acc, fold_quality_floor, tries, fold_retrain_tries,
             )
             _remove_ckpt(alt)
-            train_and_eval(
-                no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
-                save_path=alt, metric="last", seed=seed + 1009 * tries + fold,
-            )
+            retry_seed = seed + 1009 * tries + fold
+            if train_fold_fn is not None:
+                # same mechanism as the initial training (a caller's
+                # scatter/trainer override applies to retries too); the
+                # fresh seed rides on the conf, which the default
+                # train_fold_fn implementations read via conf['seed']
+                train_fold_fn(
+                    no_aug_conf.replace(**{"seed": retry_seed}), fold, alt
+                )
+            else:
+                train_and_eval(
+                    no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
+                    save_path=alt, metric="last", seed=retry_seed,
+                )
             alt_acc = evaluator.baseline(fold, alt)
             if alt_acc > acc:
                 _replace_ckpt(alt, path)
@@ -467,20 +529,27 @@ def search_policies(
                 fold, params, batch_stats, policy_t,
                 jax.random.fold_in(key_fold, trial_idx),
             )
+            if "tta_executables_first" not in result:
+                # snapshot after the very first evaluation: the
+                # zero-recompile assertion is final == first
+                try:
+                    result["tta_executables_first"] = int(
+                        evaluator.tta_step._cache_size())
+                except Exception:  # noqa: BLE001
+                    result["tta_executables_first"] = None
             tpe.tell(proposal, metrics["top1_valid"])
             fold_trials.append((proposal, metrics["top1_valid"]))
+            # persist EVERY trial (fsync + atomic rename): a crash loses
+            # at most the in-flight evaluation (VERDICT r3, weak 4); the
+            # JSON is small and the write is trivially cheap next to a
+            # compiled TTA evaluation
+            trials_log[str(fold)] = fold_trials
+            _write_json_atomic(trials_path, trials_log)
             if trial_idx % 10 == 0 or trial_idx == num_search - 1:
                 logger.info(
                     "phase2 fold %d trial %d/%d: top1_valid=%.4f best=%.4f",
                     fold, trial_idx, num_search, metrics["top1_valid"], tpe.best[1],
                 )
-                trials_log[str(fold)] = fold_trials
-                with open(trials_path, "w") as fh:
-                    json.dump(trials_log, fh)
-
-        trials_log[str(fold)] = fold_trials
-        with open(trials_path, "w") as fh:
-            json.dump(trials_log, fh)
 
     # top-N per fold from the trial log (covers folds run here, folds
     # merged from other hosts, and folds resumed from disk alike,
@@ -507,6 +576,22 @@ def search_policies(
     final_policy_set = remove_duplicates(final_policy_set)
     result["num_sub_policies_selected"] = len(final_policy_set)
     result["tpu_secs_phase2"] = (time.time() - t0) * mesh.size
+    # compile-cache census: the whole point of policy-as-tensor TTA is
+    # that EVERY trial reuses one executable (SURVEY.md hard-part 3) —
+    # record the jit cache size so the search-cost artifact can assert
+    # zero recompiles across all num_search x folds evaluations
+    try:
+        result["tta_executables"] = int(evaluator.tta_step._cache_size())
+    except Exception:  # noqa: BLE001 — private API, jax-version dependent
+        result["tta_executables"] = None
+    first = result.get("tta_executables_first")
+    if (result["tta_executables"] is not None and first is not None
+            and result["tta_executables"] > first):
+        logger.warning(
+            "phase2: TTA executables grew %d -> %d across trials — policy "
+            "recompilation is leaking into the trial loop",
+            first, result["tta_executables"],
+        )
 
     # ---------------- phase 2.5: per-sub-policy audit -----------------
     if audit_floor is not None and final_policy_set:
@@ -534,14 +619,13 @@ def search_policies(
         )
         result["tpu_secs_audit"] = (time.time() - t0) * mesh.size
         result["num_sub_policies_dropped"] = len(audit["dropped"])
-        with open(os.path.join(save_dir, "audit.json"), "w") as fh:
-            json.dump(audit, fh, indent=1)
+        _write_json_atomic(os.path.join(save_dir, "audit.json"), audit)
 
     result["final_policy_set"] = final_policy_set
     result["num_sub_policies"] = len(final_policy_set)
 
-    with open(os.path.join(save_dir, "final_policy.json"), "w") as fh:
-        json.dump(final_policy_set, fh)
+    _write_json_atomic(os.path.join(save_dir, "final_policy.json"),
+                       final_policy_set)
     logger.info(
         "search done: %d sub-policies; phase1 %.1f TPU-s, phase2 %.1f TPU-s",
         len(final_policy_set), result["tpu_secs_phase1"], result["tpu_secs_phase2"],
@@ -677,12 +761,16 @@ def audit_sub_policies(
                          np.zeros((chunk - real,) + block.shape[1:], np.float32)])
                 bsum = np.zeros(chunk)
                 bcnt = 0.0
+                block_dev = jnp.asarray(block)  # one upload per chunk
                 for bi, batch in enumerate(evaluator.batches_fn(fold)()):
+                    # chained fold_in: collision-free for any batch
+                    # count (a single mixed integer collides once a
+                    # fold yields >131 batches, e.g. ImageNet folds)
+                    k = jax.random.PRNGKey(num_draws_key)
+                    for part in (fold, start, bi):
+                        k = jax.random.fold_in(k, part)
                     out = evaluator.audit_eval(
-                        params, batch_stats, batch, jnp.asarray(block),
-                        jax.random.fold_in(
-                            jax.random.PRNGKey(num_draws_key),
-                            fold * 100003 + start * 131 + bi),
+                        params, batch_stats, batch, block_dev, k,
                     )
                     bsum += np.asarray(out["correct_mean_sum"])
                     bcnt += float(out["cnt"])

@@ -239,6 +239,85 @@ def test_tpe_beats_random_at_small_budget():
     assert noisy["gain"] > -0.02, noisy
 
 
+def test_cli_defaults_are_the_validated_guards():
+    """Round-3 regression (VERDICT r3, weak 1): the CLI's DEFAULT guard
+    settings must be the validated recipe, not the settings that
+    reproduced the round-2 destructive selection (audit floor 0.7, gate
+    off — committed evidence search_e2e_r3/search_result_floor0.70.json)."""
+    from fast_autoaugment_tpu.launch.search_cli import build_parser
+
+    args = build_parser().parse_args(["-c", "conf.yaml"])
+    assert args.audit_floor == 0.95
+    assert args.fold_quality_floor == "auto"
+    assert args.num_search == 200 and args.num_fold == 5  # reference scale
+
+
+def test_resolve_quality_floor():
+    from fast_autoaugment_tpu.search.driver import resolve_quality_floor
+
+    # auto = chance-relative: close >=35% of the chance-to-perfect gap
+    assert resolve_quality_floor("auto", 10) == pytest.approx(0.415)
+    assert resolve_quality_floor("auto", 2) == pytest.approx(0.675)
+    assert resolve_quality_floor("auto", 120) == pytest.approx(
+        1 / 120 + 0.35 * (1 - 1 / 120))
+    assert resolve_quality_floor("off", 10) is None
+    assert resolve_quality_floor(None, 10) is None
+    assert resolve_quality_floor(0.45, 10) == 0.45
+    assert resolve_quality_floor("0.6", 10) == 0.6
+    assert resolve_quality_floor(-1.0, 10) is None
+
+
+@pytest.mark.slow
+def test_phase2_crash_loses_at_most_inflight_trial(tmp_path, monkeypatch):
+    """Per-trial persistence (VERDICT r3, weak 4): kill the search mid-
+    fold and the trial log must already hold every COMPLETED trial; the
+    resumed run finishes the budget without re-evaluating them."""
+    from fast_autoaugment_tpu.core.config import Config
+    from fast_autoaugment_tpu.search import driver
+    from fast_autoaugment_tpu.search.driver import search_policies
+
+    conf = Config({
+        "model": {"type": "wresnet10_1"},
+        "dataset": "synthetic",
+        "aug": "default",
+        "cutout": 8,
+        "batch": 8,
+        "epoch": 1,
+        "lr": 0.05,
+        "lr_schedule": {"type": "cosine"},
+        "optimizer": {"type": "sgd", "decay": 1e-4, "clip": 5.0,
+                      "momentum": 0.9, "nesterov": True},
+    })
+    save = str(tmp_path / "search")
+    kwargs = dict(
+        dataroot=str(tmp_path), save_dir=save, cv_num=1, cv_ratio=0.4,
+        num_policy=2, num_op=2, num_search=6, num_top=2,
+    )
+
+    orig = driver._FoldEval.evaluate
+    calls = {"n": 0}
+
+    def crashing(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 4:  # simulated kill mid-fold, 3 trials done
+            raise KeyboardInterrupt("simulated kill")
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(driver._FoldEval, "evaluate", crashing)
+    with pytest.raises(KeyboardInterrupt):
+        search_policies(conf, **kwargs)
+    trials = json.load(open(os.path.join(save, "search_trials.json")))
+    assert len(trials["0"]) == 3  # every completed trial persisted
+
+    monkeypatch.setattr(driver._FoldEval, "evaluate", orig)
+    result = search_policies(conf, **kwargs)  # resume=True default
+    trials = json.load(open(os.path.join(save, "search_trials.json")))
+    assert len(trials["0"]) == 6
+    assert result["final_policy_set"]
+    # one executable served every TTA evaluation (no recompiles)
+    assert result["tta_executables"] in (None, 1)
+
+
 def test_audit_batched_matches_sequential():
     """The chunked audit step (make_audit_step, sub-policy axis vmapped)
     must agree with per-sub-policy TTA evaluation up to augmentation

@@ -1,0 +1,46 @@
+"""Student-t survival function + paired t-test (utils/stats.py) —
+verified against closed forms and asymptotics, not scipy (not a
+dependency)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.utils.stats import paired_t_test, t_sf
+
+
+def test_t_sf_cauchy_closed_form():
+    # df=1 is Cauchy: sf(t) = 1/2 - arctan(t)/pi
+    for t in (0.0, 0.5, 1.0, 2.0, 10.0):
+        expected = 0.5 - math.atan(t) / math.pi
+        assert t_sf(t, 1) == pytest.approx(expected, abs=1e-6)
+
+
+def test_t_sf_symmetry_and_normal_limit():
+    assert t_sf(0.0, 7) == pytest.approx(0.5, abs=1e-9)
+    assert t_sf(-1.3, 7) == pytest.approx(1.0 - t_sf(1.3, 7), abs=1e-9)
+    # large df approaches the normal: sf(1.959964) -> 0.025
+    assert t_sf(1.959964, 10000) == pytest.approx(0.025, abs=5e-4)
+    # known table value: t_sf(2.0, 7) = 0.0428 (two-sided 0.0856)
+    assert t_sf(2.0, 7) == pytest.approx(0.0428, abs=5e-4)
+
+
+def test_paired_t_test_known_case():
+    # d = a - b = [1, 2, 3, 4]: mean 2.5, sd sqrt(5/3), t = 3.873
+    a = np.array([2.0, 4.0, 6.0, 8.0])
+    b = np.array([1.0, 2.0, 3.0, 4.0])
+    out = paired_t_test(a, b)
+    assert out["n"] == 4 and out["df"] == 3
+    assert out["mean_diff"] == pytest.approx(2.5)
+    assert out["t_stat"] == pytest.approx(2.5 / (math.sqrt(5.0 / 3.0) / 2.0), rel=1e-9)
+    # scipy.stats.ttest_rel gives p=0.030466 for this data
+    assert out["p_value"] == pytest.approx(0.0305, abs=2e-3)
+
+
+def test_paired_t_test_degenerate():
+    same = np.array([1.0, 1.0, 1.0])
+    assert paired_t_test(same, same)["p_value"] == 1.0
+    assert paired_t_test(same + 2.0, same)["p_value"] == 0.0
+    with pytest.raises(ValueError):
+        paired_t_test([1.0], [2.0])

@@ -1,0 +1,139 @@
+"""Real-data reproduction fire-drill (``make reproduce``).
+
+The repo's accuracy evidence is synthetic-only because this build
+environment is zero-egress (no CIFAR pickle, SVHN .mat or reference
+.pth exists on disk).  This tool is the one-command path that fires the
+moment data and hardware appear (VERDICT r3, next-step 8):
+
+1. fetch CIFAR-10 (and optionally SVHN/CIFAR-100) with the same
+   integrity-gated transfer the ImageNet machinery uses
+   (``imagenet_tools.fetch``: md5-verified, .part + atomic rename,
+   resumable) — skipping gracefully when the network is unreachable;
+2. train WRN-40-2 with the shipped ``fa_reduced_cifar10`` policy
+   archive at the reference's headline config
+   (``confs/wresnet40x2_cifar.yaml``; reference README.md:20 — FAA 3.6
+   / published checkpoint 3.52 top-1 error);
+3. evaluate any published reference ``.pth`` checkpoints present under
+   ``--ckpt-dir`` through the import + only-eval manifest
+   (``tools/reproduce_checkpoints.py``).
+
+    python tools/reproduce.py --dataroot ./data [--datasets cifar10,svhn]
+        [--ckpt-dir ./ckpts] [--epochs N] [--dry-run]
+
+Exit code 0 on a graceful offline skip (nothing fetched, nothing to
+do), so CI can run the drill unconditionally.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.error
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fast_autoaugment_tpu.data.imagenet_tools import extract_tar, fetch  # noqa: E402
+
+# public dataset mirrors + md5s (same values torchvision pins;
+# reference data.py:114-134 downloads through torchvision)
+DATA_TABLE: dict[str, list[dict]] = {
+    "cifar10": [{
+        "url": "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+        "md5": "c58f30108f718f92721af3b95e74349a",
+        "extract": True,
+    }],
+    "cifar100": [{
+        "url": "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz",
+        "md5": "eb9058c3a382ffc7106e4002c42a8d85",
+        "extract": True,
+    }],
+    "svhn": [
+        {"url": "http://ufldl.stanford.edu/housenumbers/train_32x32.mat",
+         "md5": "e26dedcc434d2e4c54c9b2d4a06d8373", "extract": False},
+        {"url": "http://ufldl.stanford.edu/housenumbers/test_32x32.mat",
+         "md5": "eb5a983be6a315427106f1b164d9cef3", "extract": False},
+        {"url": "http://ufldl.stanford.edu/housenumbers/extra_32x32.mat",
+         "md5": "a93ce644f1a588dc4d68dda5feec44a7", "extract": False},
+    ],
+}
+
+
+def fetch_datasets(dataroot: str, names: list[str]) -> list[str]:
+    """Fetch + verify + extract each dataset; returns those available
+    locally afterwards.  Network failures skip (offline is normal)."""
+    ready = []
+    for name in names:
+        ok = True
+        for item in DATA_TABLE[name]:
+            try:
+                path = fetch(item["url"], dataroot, md5=item["md5"])
+            except (urllib.error.URLError, OSError, IOError) as e:
+                print(f"[reproduce] {name}: fetch failed ({e}) — skipping "
+                      "(offline build environment?)")
+                ok = False
+                break
+            if item["extract"]:
+                extract_tar(path, dataroot)
+        if ok:
+            ready.append(name)
+    return ready
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dataroot", default="./data")
+    p.add_argument("--datasets", default="cifar10")
+    p.add_argument("--ckpt-dir", default="./ckpts")
+    p.add_argument("--save", default="ckpt/reproduce_wresnet40x2.msgpack")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="override conf epoch (smoke runs)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="fetch/verify only; no training or eval")
+    args = p.parse_args(argv)
+
+    names = [n.strip() for n in args.datasets.split(",") if n.strip()]
+    unknown = [n for n in names if n not in DATA_TABLE]
+    if unknown:
+        p.error(f"unknown datasets {unknown}; choose from {sorted(DATA_TABLE)}")
+    ready = fetch_datasets(args.dataroot, names)
+    print(f"[reproduce] datasets ready: {ready or 'none'}")
+
+    did_anything = False
+    if "cifar10" in ready and not args.dry_run:
+        from fast_autoaugment_tpu.core.config import load_config
+        from fast_autoaugment_tpu.train.trainer import train_and_eval
+
+        overrides = [f"epoch={args.epochs}"] if args.epochs else []
+        conf = load_config("confs/wresnet40x2_cifar.yaml", overrides=overrides)
+        print("[reproduce] training WRN-40-2 + fa_reduced_cifar10 "
+              f"({conf['epoch']} epochs) -> {args.save}")
+        os.makedirs(os.path.dirname(args.save) or ".", exist_ok=True)
+        res = train_and_eval(conf, args.dataroot, test_ratio=0.0,
+                             save_path=args.save, metric="test")
+        top1 = res.get("top1_test", 0.0)
+        print(f"[reproduce] WRN-40-2 cifar10 top1_test={top1:.4f} "
+              f"(error {100 * (1 - top1):.2f}%; reference FAA 3.6, "
+              "published ckpt 3.52 — README.md:20)")
+        did_anything = True
+
+    if os.path.isdir(args.ckpt_dir) and not args.dry_run:
+        present = [f for f in os.listdir(args.ckpt_dir) if f.endswith(".pth")]
+        if present and ready:
+            import tools.reproduce_checkpoints as rc
+
+            print(f"[reproduce] evaluating {len(present)} published checkpoints")
+            rc_code = rc.main(["--ckpt-dir", args.ckpt_dir,
+                               "--dataroot", args.dataroot])
+            if rc_code:
+                return rc_code  # failed reproduction must fail the drill
+            did_anything = True
+
+    if not did_anything:
+        print("[reproduce] nothing to do (no data fetched, no checkpoints "
+              "present) — graceful skip")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 defaults-safety validation (VERDICT round 3, next-steps 3+4).
+#
+# Runs the full 3-phase search on the pose-varying glyph task with CLI
+# DEFAULTS — no guard flags at all.  Round 3's validated recipe
+# (audit floor 0.95, fold-quality gate on, 200-epoch phase 1) is now
+# the default configuration (search_cli.py + the conf), so a user
+# typing the documented command line gets the validated behavior, not
+# the round-2 failure mode.  Phase 3 runs >=8 seeds per mode and the
+# artifact records per-seed values, std and a paired t-test.
+#
+#   bash tools/run_search_e2e_r4.sh [dataset] [save_dir] [seeds]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATASET="${1:-synthetic_shapes_pose300}"
+SAVE="${2:-search_e2e_r4_defaults}"
+SEEDS="${3:-10}"
+
+# clean CPU env (the dead-tunnel PJRT plugin wedges any interpreter
+# that keeps PALLAS_AXON_POOL_IPS; tests/conftest.py)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m fast_autoaugment_tpu.launch.search_cli \
+    -c confs/wresnet10x1_shapes_hard.yaml \
+    --dataroot ./data \
+    --save-dir "$SAVE" \
+    --seed 1 \
+    --num-result-per-cv "$SEEDS" \
+    "dataset=$DATASET" \
+    2>&1 | tee "$SAVE.log"

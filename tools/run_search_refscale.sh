@@ -1,0 +1,63 @@
+#!/bin/bash
+# Reference-scale policy search (VERDICT round 3, next-step 2).
+#
+# The reference's production search shape (search.py:211-263,
+# data.py:119): 5 folds x 200 TPE samples, WRN-40-2, batch 128, on a
+# 4,000-sample 32px 10-class dataset, guards on (CLI defaults).  No
+# CIFAR pickle exists in this zero-egress environment, so the dataset
+# is the reference-SHAPED synthetic stand-in
+# `synthetic_shapes_pose4000` (4,000 train / 2,000 test, 32px, 10
+# classes, pose-varying glyphs) — clearly labeled as such in the
+# artifact; swap `DATASET=reduced_cifar10` when real data is present.
+#
+#   bash tools/run_search_refscale.sh full      # TPU: the real thing
+#   bash tools/run_search_refscale.sh costcert  # CPU: cost certification
+#
+# `full` certifies the <1 TPU-hour north star end to end (phases 1-3).
+# `costcert` runs on the CPU host where full production depth is
+# computationally out of reach (WRN-40-2 phase 1 alone is ~15 h/fold at
+# CPU throughput): it keeps every SHAPE production-exact (model, batch,
+# fold sizes, TTA draw count) but truncates phase-1 depth and the trial
+# budget (NUM_SEARCH/fold), measures per-trial and per-epoch unit
+# costs, and asserts the zero-recompile property across folds — the
+# extrapolation basis recorded in docs/BENCHMARKS.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+DATASET="${DATASET:-synthetic_shapes_pose4000}"
+
+case "$MODE" in
+full)
+    SAVE="${SAVE:-search_refscale}"
+    python -m fast_autoaugment_tpu.launch.search_cli \
+        -c confs/wresnet40x2_cifar.yaml \
+        --dataroot ./data \
+        --save-dir "$SAVE" \
+        --seed 1 \
+        "dataset=$DATASET" \
+        2>&1 | tee "$SAVE.log"
+    ;;
+costcert)
+    SAVE="${SAVE:-search_refscale_costcert}"
+    NUM_SEARCH="${NUM_SEARCH:-3}"
+    # clean CPU env: the dead-tunnel PJRT plugin hangs/aborts any
+    # interpreter that keeps PALLAS_AXON_POOL_IPS (tests/conftest.py)
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python -m fast_autoaugment_tpu.launch.search_cli \
+        -c confs/wresnet40x2_cifar.yaml \
+        --dataroot ./data \
+        --save-dir "$SAVE" \
+        --seed 1 \
+        --num-search "$NUM_SEARCH" \
+        --num-top 1 \
+        --phase1-epochs 2 \
+        --until 2 \
+        "dataset=$DATASET" \
+        2>&1 | tee "$SAVE.log"
+    ;;
+*)
+    echo "usage: $0 [full|costcert]" >&2
+    exit 2
+    ;;
+esac

@@ -1,0 +1,108 @@
+#!/bin/bash
+# Opportunistic TPU capture loop (VERDICT round 3, next-step 1).
+#
+# The TPU tunnel flaps for whole rounds; the official perf record needs
+# a real-chip number the moment one is reachable.  This loop probes the
+# chip cheaply and, as soon as a probe answers, fires the capture
+# ladder in order of value-per-minute:
+#
+#   1. python bench.py                  -> docs/bench_tpu_latest.json
+#   2. python tools/bench_aug.py        -> docs/aug_bench_tpu.txt
+#      (the promised TPU re-profile of the augmentation engine: the
+#      trace-derived per-op cost table)
+#   3. bash tools/run_search_refscale.sh full   -> search_refscale/
+#      (reference-scale search, certifies the <1 TPU-hour claim)
+#
+# Each stage commits its artifact immediately (path-scoped commits so a
+# mid-ladder tunnel death still leaves evidence in git), records a
+# marker in .ambush/ and is skipped on later revivals once captured.
+#
+#   nohup bash tools/tpu_ambush.sh >> tpu_ambush.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p .ambush
+LOCK=.ambush/lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+    echo "[ambush] another instance holds $LOCK — exiting"
+    exit 0
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+
+PROBE_TIMEOUT="${AMBUSH_PROBE_TIMEOUT:-150}"
+SLEEP_SECS="${AMBUSH_SLEEP_SECS:-300}"
+
+log() { echo "[ambush $(date -u +%H:%M:%S)] $*"; }
+
+probe() {
+    timeout "$PROBE_TIMEOUT" python -c \
+        "import jax; d = jax.devices()[0]; assert d.platform != 'cpu', d" \
+        >/dev/null 2>&1
+}
+
+commit_paths() {  # commit_paths <msg> <path...>
+    local msg="$1"; shift
+    for _ in 1 2 3 4 5; do
+        if git add -f "$@" && git commit -m "$msg" -- "$@"; then
+            return 0
+        fi
+        sleep 15   # index.lock contention with the foreground session
+    done
+    log "commit failed for: $*"
+    return 1
+}
+
+while true; do
+    if [ -e .ambush/done ]; then
+        log "all stages captured — exiting"
+        exit 0
+    fi
+    if ! probe; then
+        sleep "$SLEEP_SECS"
+        continue
+    fi
+    log "TPU probe ALIVE"
+
+    if [ ! -e .ambush/bench ]; then
+        log "stage 1: bench.py"
+        if FAA_BENCH_PROBE_TIMEOUT=60 FAA_BENCH_RETRY_WINDOW=120 \
+                timeout 2400 python bench.py > .ambush/bench_out.json 2>.ambush/bench.log \
+                && grep -vq cpu-fallback .ambush/bench_out.json \
+                && [ -s docs/bench_tpu_latest.json ]; then
+            touch .ambush/bench
+            commit_paths "TPU bench captured opportunistically: persist docs/bench_tpu_latest.json" \
+                docs/bench_tpu_latest.json
+        else
+            log "bench failed (tunnel died mid-run?)"; tail -3 .ambush/bench.log
+        fi
+    fi
+
+    if [ -e .ambush/bench ] && [ ! -e .ambush/aug ]; then
+        log "stage 2: aug op-cost table on TPU"
+        if timeout 1800 python tools/bench_aug.py --batch 128 --steps 20 \
+                > docs/aug_bench_tpu.txt 2>.ambush/aug.log \
+                && grep -q "full stack" docs/aug_bench_tpu.txt; then
+            touch .ambush/aug
+            commit_paths "TPU re-profile of the augmentation engine: per-op cost table" \
+                docs/aug_bench_tpu.txt
+        else
+            log "aug bench failed"; tail -3 .ambush/aug.log
+        fi
+    fi
+
+    if [ -e .ambush/bench ] && [ ! -e .ambush/refscale ]; then
+        log "stage 3: reference-scale search on TPU"
+        if timeout 21600 bash tools/run_search_refscale.sh full; then
+            touch .ambush/refscale
+            commit_paths "Reference-scale search on TPU: 5 folds x 200 trials at production shape" \
+                search_refscale/search_result.json search_refscale/audit.json \
+                search_refscale/final_policy.json search_refscale.log
+        else
+            log "refscale search failed or timed out"
+        fi
+    fi
+
+    if [ -e .ambush/bench ] && [ -e .ambush/aug ] && [ -e .ambush/refscale ]; then
+        touch .ambush/done
+    fi
+    sleep "$SLEEP_SECS"
+done
