@@ -18,6 +18,7 @@ Differences by design, not omission:
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Any, Callable
 
@@ -303,9 +304,24 @@ def train_and_eval(
             transform=shard_transform(mesh),
         )
         pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
-        for batch in batches:
+        # live per-batch progress (the reference's tqdm postfix,
+        # train.py:79-88): FAA_PROGRESS=N prints a loss-EMA line every N
+        # batches.  Off by default — reading metrics per batch forces a
+        # device sync and stalls the dispatch pipeline, which is why the
+        # epoch loop otherwise never touches metric values mid-epoch.
+        progress_every = int(os.environ.get("FAA_PROGRESS", "0") or 0)
+        loss_ema = None
+        for bi, batch in enumerate(batches):
             state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
             acc.add_dict(metrics)
+            if is_master and progress_every and (bi + 1) % progress_every == 0:
+                cur = float(metrics["loss"]) / max(float(metrics["num"]), 1.0)
+                loss_ema = cur if loss_ema is None else 0.9 * loss_ema + 0.1 * cur
+                sys.stderr.write(
+                    f"\r[epoch {epoch} batch {bi + 1}] loss_ema={loss_ema:.4f} ")
+                sys.stderr.flush()
+        if is_master and progress_every and loss_ema is not None:
+            sys.stderr.write("\n")
         train_metrics = acc.normalize()
         if not train_metrics:
             raise RuntimeError(

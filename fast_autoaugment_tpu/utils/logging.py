@@ -5,6 +5,13 @@ tensorboardX writer trio (reference ``train.py:176-181``) with a
 dependency-free scalar writer that appends JSONL events; a no-op writer
 stands in on non-master hosts (the analog of ``SummaryWriterDummy``,
 reference ``metrics.py:88-93``).
+
+TensorBoard-compatible export: set ``FAA_TB_EVENTS=1`` (or pass
+``tb=True`` to :func:`make_writers`) to additionally write real
+``tfevents`` files under ``<logdir>/tb/<run>_<split>/`` — the in-tree
+format writer (`utils/tb_events.py`) needs no tensorboard install.
+Opt-in because search flows create many short runs whose sidecar
+promotion tracks the fixed JSONL names, not timestamped event files.
 """
 
 from __future__ import annotations
@@ -85,8 +92,43 @@ class NullWriter:
         pass
 
 
-def make_writers(logdir: str | None, tag: str, is_master: bool):
-    """Build (train, valid, test) writers; no-ops off-master or without logdir."""
+class TeeWriter:
+    """Fan a scalar stream out to several writers (JSONL + tfevents)."""
+
+    def __init__(self, *writers):
+        self._writers = writers
+        self.path = writers[0].path if writers else None
+
+    def add_scalar(self, tag: str, value, step: int):
+        for w in self._writers:
+            w.add_scalar(tag, value, step)
+
+    def flush(self):
+        for w in self._writers:
+            w.flush()
+
+    def close(self):
+        for w in self._writers:
+            w.close()
+
+
+def make_writers(logdir: str | None, tag: str, is_master: bool,
+                 tb: bool | None = None):
+    """Build (train, valid, test) writers; no-ops off-master or without
+    logdir.  ``tb`` adds TensorBoard event files (None = read
+    ``FAA_TB_EVENTS``)."""
     if not is_master or not logdir:
         return NullWriter(), NullWriter(), NullWriter()
-    return tuple(ScalarWriter(logdir, f"{tag}_{split}") for split in ("train", "valid", "test"))
+    if tb is None:
+        tb = os.environ.get("FAA_TB_EVENTS", "0") == "1"
+    writers = []
+    for split in ("train", "valid", "test"):
+        w = ScalarWriter(logdir, f"{tag}_{split}")
+        if tb:
+            from fast_autoaugment_tpu.utils.tb_events import TBEventWriter
+
+            w = TeeWriter(
+                w, TBEventWriter(os.path.join(logdir, "tb", f"{tag}_{split}"),
+                                 split))
+        writers.append(w)
+    return tuple(writers)

@@ -49,7 +49,8 @@ def make_jpegs(root: str, n: int, size: int) -> list[str]:
     return paths
 
 
-def bench_decoder(paths, target: int, batch: int, use_native: bool) -> float:
+def bench_decoder(paths, target: int, batch: int, use_native: bool,
+                  threads: int | None = None) -> float:
     """images/sec for full-frame decode+resize over all paths."""
     from fast_autoaugment_tpu.data import native_loader
 
@@ -62,7 +63,8 @@ def bench_decoder(paths, target: int, batch: int, use_native: bool) -> float:
             full = np.array(
                 [[0, 0, w, h] for w, h in
                  (native_loader.image_size(p) for p in chunk)], np.float32)
-            out, failures = native_loader.decode_resize_batch(chunk, target, full)
+            out, failures = native_loader.decode_resize_batch(
+                chunk, target, full, threads=threads)
             assert failures == 0
         else:
             import PIL.Image
@@ -109,6 +111,14 @@ def main(argv=None):
     p.add_argument("--target", type=int, default=224)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--depths", default="1,2,4,8")
+    p.add_argument("--threads-sweep", default=None,
+                   help="comma list (e.g. 1,2,4,8,16): additionally bench "
+                        "the native decoder's thread-pool scaling — the "
+                        "measurement that justifies (or not) the C++ pool "
+                        "on multi-core TPU-VM hosts.  On this 1-core "
+                        "container the curve is flat by construction; the "
+                        "claim stays 'unproven at scale' until run on a "
+                        "real multi-core host (docs/loader_bench.md)")
     p.add_argument("--report", default=None)
     args = p.parse_args(argv)
 
@@ -130,6 +140,18 @@ def main(argv=None):
               f"({rows['native'] / rows['pil']:.1f}x PIL)")
     else:
         print("native loader not built (make -C native)")
+
+    thread_rows = {}
+    if args.threads_sweep and native_loader.available():
+        sweep = [int(t) for t in args.threads_sweep.split(",")]
+        for th in sweep:
+            thread_rows[th] = bench_decoder(paths, args.target, args.batch,
+                                            use_native=True, threads=th)
+        base_th = 1 if 1 in thread_rows else min(thread_rows)
+        base = thread_rows[base_th]
+        for th in sweep:
+            print(f"native threads={th}: {thread_rows[th]:8.1f} img/s "
+                  f"({thread_rows[th] / base:.2f}x vs {base_th} thread)")
 
     depth_rows = {}
     steps = max(2, len(paths) // args.batch - 1)
@@ -153,9 +175,16 @@ def main(argv=None):
                     f"| feed (prefetch depth {d}) | {r:.1f} |\n"
                     for d, r in depth_rows.items()
                 )
+                + "".join(
+                    f"| native decoder, {t} threads | {r:.1f} |\n"
+                    for t, r in thread_rows.items()
+                )
+                + (f"\nHost CPU count: {os.cpu_count()} — thread scaling "
+                   "measured on fewer cores than threads is queueing, not "
+                   "parallelism.\n" if thread_rows else "")
             )
         print(f"wrote {args.report}")
-    return rows, depth_rows
+    return rows, depth_rows, thread_rows
 
 
 if __name__ == "__main__":
