@@ -309,7 +309,10 @@ def train_and_eval(
         # batches.  Off by default — reading metrics per batch forces a
         # device sync and stalls the dispatch pipeline, which is why the
         # epoch loop otherwise never touches metric values mid-epoch.
-        progress_every = int(os.environ.get("FAA_PROGRESS", "0") or 0)
+        try:
+            progress_every = int(os.environ.get("FAA_PROGRESS", "0") or 0)
+        except ValueError:  # cosmetic knob must never kill a run
+            progress_every = 0
         loss_ema = None
         for bi, batch in enumerate(batches):
             state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)

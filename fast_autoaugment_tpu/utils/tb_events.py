@@ -59,6 +59,11 @@ def _masked_crc(data: bytes) -> int:
 
 
 def _varint(n: int) -> bytes:
+    # negative ints would need 10-byte two's-complement encoding AND
+    # would spin this loop forever (-1 >> 7 == -1); every value we
+    # encode (steps, lengths, field keys) is non-negative by contract
+    if n < 0:
+        raise ValueError(f"varint requires a non-negative int, got {n}")
     out = bytearray()
     while True:
         bits = n & 0x7F
@@ -123,7 +128,7 @@ class TBEventWriter:
 
     def add_scalar(self, tag: str, value, step: int):
         self._fh.write(_record(_event_bytes(
-            time.time(), step=int(step), scalar=(tag, float(value)))))
+            time.time(), step=max(0, int(step)), scalar=(tag, float(value)))))
         # records are ~60 bytes against an ~8 KB buffer: without a per-
         # record flush a live TensorBoard sees only the file header
         # until close, and a killed run loses every buffered scalar
@@ -152,6 +157,20 @@ def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
         shift += 7
 
 
+def _skip_field(buf: bytes, i: int, wire: int) -> int:
+    """Advance past one unknown field of the given wire type."""
+    if wire == 0:
+        _, i = _read_varint(buf, i)
+    elif wire == 1:
+        i += 8
+    elif wire == 5:
+        i += 4
+    else:
+        ln, i = _read_varint(buf, i)
+        i += ln
+    return i
+
+
 def _parse_value(buf: bytes) -> dict:
     out: dict = {}
     i = 0
@@ -165,30 +184,29 @@ def _parse_value(buf: bytes) -> dict:
         elif num == 2 and wire == 5:
             out["simple_value"] = struct.unpack("<f", buf[i:i + 4])[0]
             i += 4
-        else:  # skip unknown
-            if wire == 0:
-                _, i = _read_varint(buf, i)
-            elif wire == 1:
-                i += 8
-            elif wire == 5:
-                i += 4
-            else:
-                ln, i = _read_varint(buf, i)
-                i += ln
+        else:
+            i = _skip_field(buf, i, wire)
     return out
 
 
 def read_events(path: str, verify_crc: bool = True) -> list[dict]:
     """Parse a tfevents file back into dicts
-    ``{"wall_time", "step"?, "file_version"?, "tag"?, "value"?}``."""
+    ``{"wall_time", "step"?, "file_version"?, "tag"?, "value"?}``.
+
+    A truncated trailing record (killed writer mid-flush) ends the
+    parse gracefully: the complete prefix is returned."""
     events = []
     with open(path, "rb") as fh:
         data = fh.read()
     i = 0
     while i < len(data):
+        if i + 12 > len(data):
+            break  # truncated header
         header = data[i:i + 8]
         (ln,) = struct.unpack("<Q", header)
         (hcrc,) = struct.unpack("<I", data[i + 8:i + 12])
+        if i + 16 + ln > len(data):
+            break  # truncated payload/footer
         payload = data[i + 12:i + 12 + ln]
         (pcrc,) = struct.unpack("<I", data[i + 12 + ln:i + 16 + ln])
         if verify_crc:
@@ -224,8 +242,8 @@ def read_events(path: str, verify_crc: bool = True) -> list[dict]:
                         ev["tag"] = v.get("tag")
                         ev["value"] = v.get("simple_value")
                     else:
-                        break
+                        k = _skip_field(summ, k, skey & 7)
             else:
-                break
+                j = _skip_field(payload, j, wire)
         events.append(ev)
     return events

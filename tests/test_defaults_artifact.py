@@ -1,0 +1,68 @@
+"""Defaults-safety regression over the COMMITTED round-4 e2e artifact
+(VERDICT r3, next-step 3): a user running the documented CLI with pure
+defaults on the pose task must get a non-destructive policy set.  The
+artifact is produced by `tools/run_search_e2e_r4.sh` (full 3-phase
+search, no guard flags) and committed; this test pins its meaning so a
+future defaults regression cannot silently ship.
+"""
+
+import json
+import os
+
+import pytest
+
+ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "search_e2e_r4_defaults", "search_result.json")
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    if not os.path.exists(ARTIFACT):
+        pytest.skip("round-4 defaults e2e artifact not present (run "
+                    "tools/run_search_e2e_r4.sh)")
+    with open(ARTIFACT) as fh:
+        return json.load(fh)
+
+
+def test_artifact_used_cli_defaults(artifact):
+    """The artifact must certify DEFAULT guard settings — the exact
+    values build_parser ships — otherwise it proves nothing about the
+    out-of-the-box behavior."""
+    from fast_autoaugment_tpu.launch.search_cli import build_parser
+    from fast_autoaugment_tpu.search.driver import resolve_quality_floor
+
+    args = build_parser().parse_args(["-c", "x.yaml"])
+    guards = artifact["guards"]
+    assert guards["audit_floor"] == args.audit_floor == 0.95
+    assert guards["fold_quality_floor"] == pytest.approx(
+        resolve_quality_floor(args.fold_quality_floor, 10))
+
+
+def test_defaults_do_not_select_destructive_policies(artifact):
+    """The round-2 failure mode (augmented accuracy collapsing to
+    chance while default trains fine) must be impossible at defaults:
+    augmented mean >= default mean - 1pt (sampling-noise allowance) and
+    far above chance."""
+    d = artifact["phase3"]["default"]["mean"]
+    a = artifact["phase3"]["augment"]["mean"]
+    assert a >= d - 0.01, f"augmented {a:.4f} vs default {d:.4f}"
+    assert a > 0.5, f"augmented accuracy {a:.4f} is chance-level"
+
+
+def test_artifact_quantifies_the_comparison(artifact):
+    """Per-seed values, std and a paired test with >=8 seeds per mode
+    (VERDICT r3, next-step 4)."""
+    p3 = artifact["phase3"]
+    assert p3["num_runs"] >= 8
+    for mode in ("default", "augment"):
+        assert len(p3[mode]["per_seed"]) == p3["num_runs"]
+        assert p3[mode]["std"] > 0.0
+    paired = p3["paired_augment_minus_default"]
+    assert paired["n"] == p3["num_runs"]
+    assert 0.0 <= paired["p_value"] <= 1.0
+
+
+def test_zero_recompiles_across_all_trials(artifact):
+    """Policy-as-tensor TTA: one executable served every trial in every
+    fold (SURVEY.md hard-part 3)."""
+    assert artifact["tta_executables"] == artifact["tta_executables_first"] == 1

@@ -1,0 +1,91 @@
+"""Project a full reference-scale search cost from a cost-certification
+run (``tools/run_search_refscale.sh costcert``).
+
+The costcert run keeps every per-unit SHAPE production-exact (WRN-40-2,
+batch 128, 4,000-sample dataset, 2,400/1,600 fold splits, 5 TTA draws)
+but truncates phase-1 depth and the per-fold trial budget so it fits
+the CPU host.  This tool reads its ``search_result.json`` and scales
+the measured unit costs back to the reference's production shape
+(``search.py:211-263``: 5 folds x 200 trials, 200-epoch phase 1),
+emitting one JSON line for docs/BENCHMARKS.md:
+
+    python tools/extrapolate_costcert.py search_refscale_costcert \
+        [--phase1-epochs-run 2] [--target-epochs 200] \
+        [--trials-run 3] [--target-trials 200]
+
+The projection is mechanical (unit cost x count) — phase 2 trials reuse
+ONE compiled executable (asserted via tta_executables in the artifact),
+so per-trial cost is constant by construction; phase-1 epochs are
+likewise constant-cost after the first compile.  The honest caveats:
+compile time is amortized differently at full depth (smaller share),
+and the audit cost scales with the SELECTED sub-policy count, which a
+200-trial search changes — both are called out in the output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("save_dir")
+    p.add_argument("--phase1-epochs-run", type=int, default=2)
+    p.add_argument("--target-epochs", type=int, default=200)
+    p.add_argument("--trials-run", type=int, default=3)
+    p.add_argument("--target-trials", type=int, default=200)
+    p.add_argument("--tpu-speedup", type=float, default=None,
+                   help="optional measured TPU-vs-this-host step-rate "
+                        "ratio; adds a projected TPU-hours figure")
+    args = p.parse_args(argv)
+
+    with open(os.path.join(args.save_dir, "search_result.json")) as fh:
+        result = json.load(fh)
+
+    p1 = result["tpu_secs_phase1"]
+    p2 = result["tpu_secs_phase2"]
+    audit = result.get("tpu_secs_audit", 0.0)
+    folds = len(result.get("fold_baselines", {})) or 5
+
+    p1_full = p1 * args.target_epochs / max(args.phase1_epochs_run, 1)
+    p2_full = p2 * args.target_trials / max(args.trials_run, 1)
+    out = {
+        "metric": "refscale_search_cost_projection",
+        "measured": {
+            "phase1_secs": round(p1, 1),
+            "phase1_epochs": args.phase1_epochs_run,
+            "phase2_secs": round(p2, 1),
+            "trials_per_fold": args.trials_run,
+            "folds": folds,
+            "audit_secs": round(audit, 1),
+            "secs_per_trial": round(p2 / max(args.trials_run * folds, 1), 2),
+            "tta_executables": result.get("tta_executables"),
+            "zero_recompiles": (
+                result.get("tta_executables") is not None
+                and result.get("tta_executables")
+                == result.get("tta_executables_first")
+            ),
+        },
+        "projected_full_host_hours": round(
+            (p1_full + p2_full + audit) / 3600.0, 2),
+        "projection_basis": {
+            "phase1": f"{args.target_epochs} epochs x measured per-epoch cost",
+            "phase2": f"{args.target_trials} trials/fold x measured "
+                      "per-trial cost (single compiled executable)",
+            "audit": "measured as-is (scales with selected sub-policy "
+                     "count, which a larger search changes)",
+        },
+    }
+    if args.tpu_speedup:
+        out["projected_tpu_hours"] = round(
+            out["projected_full_host_hours"] / args.tpu_speedup, 3)
+        out["tpu_speedup_basis"] = args.tpu_speedup
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,24 @@ while true; do
         fi
     fi
 
+    if [ -e .ambush/bench ] && [ ! -e .ambush/trace ]; then
+        log "stage 2.5: jax.profiler traces of train + TTA steps"
+        if timeout 2400 python tools/profile_tpu.py --out docs/tpu_trace_r4 \
+                >> .ambush/trace.log 2>&1 \
+                && [ -s docs/tpu_trace_r4/summary.json ]; then
+            touch .ambush/trace
+            # commit the summary always; the raw xplane only when small
+            TRACE_PATHS="docs/tpu_trace_r4/summary.json"
+            if [ "$(du -sk docs/tpu_trace_r4 | cut -f1)" -lt 2048 ]; then
+                TRACE_PATHS="docs/tpu_trace_r4"
+            fi
+            commit_paths "jax.profiler traces of the train and TTA steps on TPU" \
+                $TRACE_PATHS
+        else
+            log "trace capture failed"; tail -3 .ambush/trace.log
+        fi
+    fi
+
     if [ -e .ambush/bench ] && [ ! -e .ambush/refscale ]; then
         log "stage 3: reference-scale search on TPU"
         if timeout 21600 bash tools/run_search_refscale.sh full; then
@@ -101,7 +119,8 @@ while true; do
         fi
     fi
 
-    if [ -e .ambush/bench ] && [ -e .ambush/aug ] && [ -e .ambush/refscale ]; then
+    if [ -e .ambush/bench ] && [ -e .ambush/aug ] && [ -e .ambush/trace ] \
+            && [ -e .ambush/refscale ]; then
         touch .ambush/done
     fi
     sleep "$SLEEP_SECS"
