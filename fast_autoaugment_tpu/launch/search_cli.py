@@ -12,13 +12,12 @@ policies, averaged — reference ``search.py:264-312``).
 from __future__ import annotations
 
 import argparse
-import json
 import time
 
 import numpy as np
 
 from fast_autoaugment_tpu.core.config import load_config
-from fast_autoaugment_tpu.search.driver import search_policies
+from fast_autoaugment_tpu.search.driver import search_policies, write_json_atomic
 from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import get_logger
 
@@ -93,13 +92,18 @@ def main(argv=None):
     )
     final_policy_set = result["final_policy_set"]
     logger.info("final policy set: %d sub-policies", len(final_policy_set))
-    if args.until < 3 or not final_policy_set:
+    def finish():
         import jax
 
-        result["tpu_hours_total"] = (time.time() - t_start) * jax.device_count() / 3600.0
-        with open(f"{args.save_dir}/search_result.json", "w") as fh:
-            json.dump({k: v for k, v in result.items() if k != "final_policy_set"}, fh)
+        result["tpu_hours_total"] = (
+            (time.time() - t_start) * jax.device_count() / 3600.0)
+        write_json_atomic(
+            f"{args.save_dir}/search_result.json",
+            {k: v for k, v in result.items() if k != "final_policy_set"})
         return result
+
+    if args.until < 3 or not final_policy_set:
+        return finish()
 
     if args.until >= 3:
         # phase 3: full retrains default vs augmented (search.py:264-312).
@@ -145,11 +149,7 @@ def main(argv=None):
             if num_runs > 1 else "",
         )
 
-    import jax
-
-    result["tpu_hours_total"] = (time.time() - t_start) * jax.device_count() / 3600.0
-    with open(f"{args.save_dir}/search_result.json", "w") as fh:
-        json.dump({k: v for k, v in result.items() if k != "final_policy_set"}, fh)
+    finish()
     logger.info("search complete: %.3f TPU-hours", result["tpu_hours_total"])
     return result
 

@@ -66,7 +66,7 @@ from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = ["search_policies", "make_search_space", "SearchResult",
-           "resolve_quality_floor"]
+           "resolve_quality_floor", "write_json_atomic"]
 
 logger = get_logger("faa_tpu.search")
 
@@ -93,15 +93,19 @@ def resolve_quality_floor(floor, num_classes: int) -> float | None:
     return floor if floor > 0 else None
 
 
-def _write_json_atomic(path: str, obj) -> None:
+def write_json_atomic(path: str, obj) -> None:
     """fsync-then-rename write: a crash mid-write can never tear the
-    file, and a crash right after loses nothing (VERDICT r3, weak 4)."""
+    file, and a crash right after loses nothing (VERDICT r3, weak 4).
+    Public: the search CLI persists its result files through this too."""
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(obj, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
+
+
+_write_json_atomic = write_json_atomic  # internal call sites
 
 
 def make_search_space(num_policy: int, num_op: int):

@@ -127,8 +127,10 @@ class TBEventWriter:
         return self._path
 
     def add_scalar(self, tag: str, value, step: int):
+        # negative steps raise (via _varint): silently clamping would
+        # pile mis-stepped scalars onto step 0 and hide the caller bug
         self._fh.write(_record(_event_bytes(
-            time.time(), step=max(0, int(step)), scalar=(tag, float(value)))))
+            time.time(), step=int(step), scalar=(tag, float(value)))))
         # records are ~60 bytes against an ~8 KB buffer: without a per-
         # record flush a live TensorBoard sees only the file header
         # until close, and a killed run loses every buffered scalar

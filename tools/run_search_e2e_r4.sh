@@ -28,3 +28,10 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     --num-result-per-cv "$SEEDS" \
     "dataset=$DATASET" \
     2>&1 | tee "$SAVE.log"
+
+# stage the committable summary artifacts (the run dir is gitignored;
+# tests/test_defaults_artifact.py reads the committed copies)
+git add -f "$SAVE/search_result.json" "$SAVE/final_policy.json" \
+    "$SAVE/audit.json" "$SAVE/search_trials.json" "$SAVE.log" 2>/dev/null || true
+echo "[e2e-r4] summary artifacts staged; commit them to activate" \
+     "tests/test_defaults_artifact.py"

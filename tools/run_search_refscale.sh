@@ -61,3 +61,8 @@ costcert)
     exit 2
     ;;
 esac
+
+# stage the committable summaries (bulk checkpoints stay gitignored)
+git add -f "$SAVE/search_result.json" "$SAVE.log" 2>/dev/null || true
+git add -f "$SAVE/final_policy.json" "$SAVE/audit.json" 2>/dev/null || true
+echo "[refscale] summary artifacts staged"
