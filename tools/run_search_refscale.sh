@@ -42,7 +42,13 @@ costcert)
     SAVE="${SAVE:-search_refscale_costcert}"
     NUM_SEARCH="${NUM_SEARCH:-3}"
     # clean CPU env: the dead-tunnel PJRT plugin hangs/aborts any
-    # interpreter that keeps PALLAS_AXON_POOL_IPS (tests/conftest.py)
+    # interpreter that keeps PALLAS_AXON_POOL_IPS (tests/conftest.py).
+    # The fold-quality gate is OFF here by necessity: a 2-epoch
+    # WRN-40-2 oracle sits at ~0.13 accuracy, so the auto gate would
+    # spend 3x phase-1 retraining and then exclude every fold — phase 2
+    # (the unit-cost measurement this mode exists for) would never run.
+    # The gate itself is validated at full depth by the committed
+    # defaults-run (search_e2e_r4_defaults/); `full` mode keeps it on.
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
         python -m fast_autoaugment_tpu.launch.search_cli \
         -c confs/wresnet40x2_cifar.yaml \
@@ -52,6 +58,7 @@ costcert)
         --num-search "$NUM_SEARCH" \
         --num-top 1 \
         --phase1-epochs 2 \
+        --fold-quality-floor off \
         --until 2 \
         "dataset=$DATASET" \
         2>&1 | tee "$SAVE.log"
