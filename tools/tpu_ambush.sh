@@ -21,12 +21,14 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p .ambush
-LOCK=.ambush/lock
-if ! mkdir "$LOCK" 2>/dev/null; then
-    echo "[ambush] another instance holds $LOCK — exiting"
+# kernel-managed mutual exclusion: flock is atomic, and the lock
+# auto-releases on ANY exit (kill -9 included) — no staleness
+# heuristics, pid files, or cleanup-trap races
+exec 9>.ambush/lock
+if ! flock -n 9; then
+    echo "[ambush] another instance holds the lock — exiting"
     exit 0
 fi
-trap 'rmdir "$LOCK" 2>/dev/null' EXIT
 
 PROBE_TIMEOUT="${AMBUSH_PROBE_TIMEOUT:-150}"
 SLEEP_SECS="${AMBUSH_SLEEP_SECS:-300}"
