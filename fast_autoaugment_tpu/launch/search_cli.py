@@ -12,6 +12,7 @@ policies, averaged — reference ``search.py:264-312``).
 from __future__ import annotations
 
 import argparse
+import math
 import time
 
 import numpy as np
@@ -22,6 +23,26 @@ from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 logger = get_logger("faa_tpu.search_cli")
+
+
+def _quality_floor_arg(value: str) -> str:
+    """Validate ``--fold-quality-floor`` at parse time (ADVICE r4): the
+    accepted forms are 'auto', 'off'/'none', or a float literal; a typo
+    fails as a CLI usage error instead of a float() traceback deep in
+    the search."""
+    if value.lower() in ("auto", "off", "none"):
+        return value.lower()
+    try:
+        f = float(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto', 'off' or a float, got {value!r}")
+    if not math.isfinite(f):
+        # float('nan') parses but nan > 0 is False, which would
+        # silently disable the gate downstream
+        raise argparse.ArgumentTypeError(
+            f"expected a finite float, got {value!r}")
+    return value
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -45,6 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--fold-quality-floor", default="auto",
+                   type=_quality_floor_arg,
                    help="fold-oracle gate: retrain (fresh seed) folds whose "
                         "no-policy baseline accuracy is below this, exclude "
                         "them from ranking if still weak.  'auto' (default) "
@@ -54,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fold-retrain-tries", type=int, default=2)
     p.add_argument("--phase1-epochs", type=int, default=None,
                    help="override conf['epoch'] for phase-1 fold pretraining")
+    p.add_argument("--phase3-random", action="store_true",
+                   help="add a random-policy control arm to phase 3: an "
+                        "equal-size uniform draw from the search space, "
+                        "audited identically, retrained on the same seeds "
+                        "(the density-matching claim is searched > random, "
+                        "not just searched > no-aug)")
     p.add_argument("--audit-floor", type=float, default=0.95,
                    help="drop selected sub-policies whose standalone "
                         "mean-over-draws fold accuracy < floor x baseline "
@@ -89,68 +117,101 @@ def main(argv=None):
         fold_retrain_tries=args.fold_retrain_tries,
         phase1_epochs=args.phase1_epochs,
         audit_floor=args.audit_floor if args.audit_floor > 0 else None,
+        random_control=args.phase3_random,
     )
     final_policy_set = result["final_policy_set"]
+    random_policy_set = result.get("random_policy_set") or []
     logger.info("final policy set: %d sub-policies", len(final_policy_set))
-    def finish():
+
+    _UNSERIALIZED = ("final_policy_set", "random_policy_set")
+
+    def persist():
+        """(Re)write search_result.json — called after EVERY phase-3
+        run so a killed process still leaves the partial record
+        (per-seed values to date) on disk."""
         import jax
 
-        result["tpu_hours_total"] = (
-            (time.time() - t_start) * jax.device_count() / 3600.0)
+        hours = (time.time() - t_start) * jax.device_count() / 3600.0
+        # honest name + legacy alias; `backend` (from search_policies)
+        # says what actually measured these hours
+        result["device_hours_total"] = hours
+        result["tpu_hours_total"] = hours
         write_json_atomic(
             f"{args.save_dir}/search_result.json",
-            {k: v for k, v in result.items() if k != "final_policy_set"})
+            {k: v for k, v in result.items() if k not in _UNSERIALIZED})
         return result
 
     if args.until < 3 or not final_policy_set:
-        return finish()
+        return persist()
 
-    if args.until >= 3:
-        # phase 3: full retrains default vs augmented (search.py:264-312).
-        # Unlike the reference's bare means, record per-seed values, the
-        # spread and a paired t-test (runs pair by seed: identical data
-        # and init, only the augmentation differs) — VERDICT r3, next-4
-        num_runs = 1 if args.smoke_test else args.num_result_per_cv
-        seeds = [args.seed + run for run in range(num_runs)]
-        outcomes = {"default": [], "augment": []}
-        for mode, aug in (("default", "default"), ("augment", final_policy_set)):
-            for run in range(num_runs):
-                mode_conf = conf.replace(aug=aug)
-                path = f"{args.save_dir}/final_{mode}_{run}.msgpack"
-                res = train_and_eval(
-                    mode_conf, args.dataroot, test_ratio=0.0,
-                    save_path=path, metric="last", seed=seeds[run],
-                )
-                outcomes[mode].append(float(res.get("top1_test", 0.0)))
-                logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,
-                            outcomes[mode][-1])
-        result["top1_test_default_mean"] = float(np.mean(outcomes["default"]))
-        result["top1_test_augment_mean"] = float(np.mean(outcomes["augment"]))
-        phase3 = {"num_runs": num_runs, "seeds": seeds}
-        for mode in ("default", "augment"):
-            vals = outcomes[mode]
-            phase3[mode] = {
+    # phase 3: full retrains, default vs augmented (search.py:264-312)
+    # plus an optional random-policy control arm.  Unlike the
+    # reference's bare means, record per-seed values, the spread and
+    # paired t-tests (runs pair by seed: identical data and init, only
+    # the augmentation differs) — VERDICT r3 next-4 / r4 next-4.
+    num_runs = 1 if args.smoke_test else args.num_result_per_cv
+    seeds = [args.seed + run for run in range(num_runs)]
+    modes = [("default", "default"), ("augment", final_policy_set)]
+    if args.phase3_random and random_policy_set:
+        modes.append(("random", random_policy_set))
+    outcomes: dict[str, list[float]] = {name: [] for name, _ in modes}
+    phase3: dict = {"num_runs": num_runs, "seeds": seeds}
+    result["phase3"] = phase3
+
+    def update_stats():
+        from fast_autoaugment_tpu.utils.stats import paired_t_test
+
+        for name, _aug in modes:
+            vals = outcomes[name]
+            if not vals:
+                continue
+            phase3[name] = {
                 "per_seed": vals,
                 "mean": float(np.mean(vals)),
                 "std": float(np.std(vals, ddof=1)) if len(vals) > 1 else 0.0,
             }
-        if num_runs > 1:
-            from fast_autoaugment_tpu.utils.stats import paired_t_test
+        for a, b in (("augment", "default"), ("augment", "random"),
+                     ("random", "default")):
+            n = min(len(outcomes.get(a, [])), len(outcomes.get(b, [])))
+            if n > 1:
+                phase3[f"paired_{a}_minus_{b}"] = paired_t_test(
+                    outcomes[a][:n], outcomes[b][:n])
+        if outcomes["default"]:
+            result["top1_test_default_mean"] = float(
+                np.mean(outcomes["default"]))
+        if outcomes["augment"]:
+            result["top1_test_augment_mean"] = float(
+                np.mean(outcomes["augment"]))
 
-            phase3["paired_augment_minus_default"] = paired_t_test(
-                outcomes["augment"], outcomes["default"]
+    # seed-major order: every completed seed adds one PAIRED
+    # observation to all arms, so an interrupted run still yields a
+    # balanced three-way comparison at whatever n it reached
+    for run in range(num_runs):
+        for mode, aug in modes:
+            mode_conf = conf.replace(aug=aug)
+            path = f"{args.save_dir}/final_{mode}_{run}.msgpack"
+            res = train_and_eval(
+                mode_conf, args.dataroot, test_ratio=0.0,
+                save_path=path, metric="last", seed=seeds[run],
             )
-        result["phase3"] = phase3
-        logger.info(
-            "phase3: default %.4f±%.4f vs augmented %.4f±%.4f (n=%d%s)",
-            phase3["default"]["mean"], phase3["default"]["std"],
-            phase3["augment"]["mean"], phase3["augment"]["std"], num_runs,
-            ", paired p=%.3f" % phase3["paired_augment_minus_default"]["p_value"]
-            if num_runs > 1 else "",
-        )
+            outcomes[mode].append(float(res.get("top1_test", 0.0)))
+            logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,
+                        outcomes[mode][-1])
+            update_stats()
+            persist()
 
-    finish()
-    logger.info("search complete: %.3f TPU-hours", result["tpu_hours_total"])
+    summary = " vs ".join(
+        "%s %.4f±%.4f" % (name, phase3[name]["mean"], phase3[name]["std"])
+        for name, _ in modes if name in phase3)
+    pvals = ", ".join(
+        "%s p=%.3f" % (k[len("paired_"):], phase3[k]["p_value"])
+        for k in sorted(phase3) if k.startswith("paired_"))
+    logger.info("phase3 (n=%d): %s%s", num_runs, summary,
+                " [%s]" % pvals if pvals else "")
+
+    persist()
+    logger.info("search complete: %.3f device-hours on %s",
+                result["tpu_hours_total"], result.get("backend", "?"))
     return result
 
 

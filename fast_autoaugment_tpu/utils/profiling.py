@@ -77,7 +77,21 @@ class PhaseStopwatch:
     pystopwatch2 + GPU-hours accounting)."""
 
     def __init__(self, device_count: int | None = None):
-        self.device_count = device_count or jax.device_count()
+        # which backend these device-seconds were measured on: a ledger
+        # without provenance reads CPU wall-time as accelerator-hours
+        # (VERDICT r4 weak 5).  Only queried when jax must be touched
+        # anyway (no explicit device_count): an offline ledger with an
+        # explicit count must not initialize a backend — on this host
+        # that can claim a dead TPU tunnel and abort the process.
+        if device_count is None:
+            self.device_count = jax.device_count()
+            dev0 = jax.devices()[0]
+            self.backend = dev0.platform
+            self.device_kind = getattr(dev0, "device_kind", dev0.platform)
+        else:
+            self.device_count = device_count
+            self.backend = "unspecified"
+            self.device_kind = "unspecified"
         self.phases: dict[str, float] = {}
         self._open: dict[str, float] = {}
 
@@ -106,7 +120,7 @@ class PhaseStopwatch:
         return self.device_seconds(name) / 3600.0
 
     def summary(self) -> dict:
-        return {
+        out = {
             name: {
                 "wall_sec": round(w, 2),
                 "device_sec": round(w * self.device_count, 2),
@@ -114,3 +128,7 @@ class PhaseStopwatch:
             }
             for name, w in self.phases.items()
         }
+        out["_meta"] = {"backend": self.backend,
+                        "device_kind": self.device_kind,
+                        "device_count": self.device_count}
+        return out

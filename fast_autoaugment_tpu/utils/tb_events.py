@@ -115,9 +115,23 @@ class TBEventWriter:
     def __init__(self, logdir: str, name: str):
         os.makedirs(logdir, exist_ok=True)
         host = socket.gethostname() or "host"
-        self._path = os.path.join(
-            logdir, f"events.out.tfevents.{int(time.time())}.{host}.{name}")
-        self._fh = open(self._path, "ab")
+        # exclusive create + numbered retry: two writers born in the
+        # same second must not append to one file (a second mid-stream
+        # file_version record corrupts the stream for TensorBoard)
+        base = os.path.join(
+            logdir,
+            f"events.out.tfevents.{int(time.time())}.{host}.{os.getpid()}"
+            f".{name}")
+        for attempt in range(1000):
+            path = base if attempt == 0 else f"{base}.{attempt}"
+            try:
+                self._fh = open(path, "xb")
+                self._path = path
+                break
+            except FileExistsError:
+                continue
+        else:
+            raise OSError(f"could not create a unique event file at {base}")
         self._fh.write(_record(_event_bytes(time.time(),
                                             file_version="brain.Event:2")))
         self._fh.flush()
@@ -212,8 +226,12 @@ def read_events(path: str, verify_crc: bool = True) -> list[dict]:
         payload = data[i + 12:i + 12 + ln]
         (pcrc,) = struct.unpack("<I", data[i + 12 + ln:i + 16 + ln])
         if verify_crc:
-            assert _masked_crc(header) == hcrc, f"header crc @ {i}"
-            assert _masked_crc(payload) == pcrc, f"payload crc @ {i}"
+            # explicit raises, not asserts: `python -O` strips asserts,
+            # which would silently void the verify_crc=True contract
+            if _masked_crc(header) != hcrc:
+                raise ValueError(f"header crc mismatch @ {i} in {path}")
+            if _masked_crc(payload) != pcrc:
+                raise ValueError(f"payload crc mismatch @ {i} in {path}")
         i += 16 + ln
 
         ev: dict = {}
