@@ -8,7 +8,10 @@ reference's headline config (``confs/wresnet40x2_cifar.yaml``: batch
 128 per device).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
-"images_per_sec_hostfeed", ...}.
+"images_per_sec_hostfeed", "contention", "tta_trials_per_sec", ...}.
+Every artifact is loadavg-stamped at capture start (`contention`) and
+carries the phase-2 scheduler throughput at candidate-batch K in
+{1, 4, 16} (`tta_trials_per_sec`; see bench_tta_scheduler).
 
 Baseline: the reference pipeline (PyTorch + 8 PIL CPU workers per GPU)
 sustains roughly 1500 images/s/GPU on a V100-class device for WRN-40-2
@@ -61,6 +64,55 @@ _PEAK_FLOPS_BF16 = {
 
 def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def host_contention_stamp() -> dict:
+    """Load/contention provenance for a bench artifact.
+
+    VERDICT r5 weak 1: an official round number was captured while the
+    host was busy, and nothing in the artifact said so.  Every bench
+    JSON now carries the 1/5/15-minute load averages, the core count
+    and the process count AT CAPTURE START, plus a ``contended`` verdict
+    (pre-existing 1-minute load above 75% of the cores) — so a busy-host
+    capture is visible in the artifact itself.  Set
+    ``FAA_BENCH_REQUIRE_QUIET=1`` to make the bench REFUSE to run
+    (exit 3) instead of merely flagging.
+    """
+    stamp: dict = {"cpu_count": os.cpu_count()}
+    try:
+        la1, la5, la15 = os.getloadavg()
+        stamp["loadavg_1m"] = round(la1, 2)
+        stamp["loadavg_5m"] = round(la5, 2)
+        stamp["loadavg_15m"] = round(la15, 2)
+    except OSError:  # not available on this platform
+        stamp["loadavg_1m"] = stamp["loadavg_5m"] = stamp["loadavg_15m"] = None
+    try:
+        stamp["process_count"] = sum(
+            1 for d in os.listdir("/proc") if d.isdigit())
+    except OSError:
+        stamp["process_count"] = None
+    la1 = stamp["loadavg_1m"]
+    stamp["contended"] = bool(
+        la1 is not None and la1 > 0.75 * (stamp["cpu_count"] or 1))
+    return stamp
+
+
+def refuse_or_flag_contention(stamp: dict) -> dict:
+    """Exit under FAA_BENCH_REQUIRE_QUIET on a busy host, else annotate."""
+    if not stamp.get("contended"):
+        return stamp
+    msg = (f"host is contended at capture start: loadavg_1m="
+           f"{stamp['loadavg_1m']} on {stamp['cpu_count']} core(s), "
+           f"{stamp['process_count']} processes")
+    if os.environ.get("FAA_BENCH_REQUIRE_QUIET"):
+        _log(f"REFUSING to bench ({msg}); unset FAA_BENCH_REQUIRE_QUIET "
+             "to capture anyway (the artifact would be flagged)")
+        sys.exit(3)
+    _log(f"WARNING: {msg} — artifact will be flagged contended=true; do "
+         "not commit it as an official number")
+    stamp["note"] = ("captured under host contention — timings are "
+                     "unreliable; not an official number")
+    return stamp
 
 
 def _chip_peak_flops(device) -> float | None:
@@ -154,7 +206,138 @@ def _ensure_live_backend(reexec_argv=None, fallback_env=None):
     os.execvpe(reexec_argv[0], reexec_argv, env)
 
 
+def bench_tta_scheduler(ks=(1, 4, 16), trials_per_k=None) -> dict:
+    """Phase-2 scheduler throughput: TTA trials/sec at candidate-batch K.
+
+    Runs a faithful miniature of `search/driver.py` phase 2 — real
+    in-tree TPE proposals (`ask(K)`/`tell_batch`), real policy
+    decode/tensorize, the real compiled TTA step (`make_tta_step`,
+    candidate axis vmapped for K>1), and the real per-round fsync
+    trial-log persist — at a deliberately tiny probe shape
+    (`FAA_BENCH_TTA_MODEL` @ `FAA_BENCH_TTA_IMG` px, batch
+    `FAA_BENCH_TTA_BATCH`, 1 TTA draw) so the FIXED per-trial costs the
+    batched scheduler amortizes (dispatch, host sync, fsync persist,
+    proposal overhead) are visible next to the device math.  K=1 is the
+    sequential scheduler code path (`suggest`/`tell`, one program per
+    trial); K>1 evaluates K trials per device program.
+
+    On a TPU the same amortization applies to a device that finishes
+    the math orders of magnitude faster, PLUS the K*P*B batch actually
+    fills the MXU — so the CPU-measured speedup is a LOWER bound on the
+    scheduling win, not a chip throughput claim.  The headline train
+    bench above stays the chip-throughput number.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from fast_autoaugment_tpu.models import get_model
+    from fast_autoaugment_tpu.policies.archive import (
+        policy_decoder,
+        policy_to_tensor,
+    )
+    from fast_autoaugment_tpu.search.driver import (
+        make_search_space,
+        write_json_atomic,
+    )
+    from fast_autoaugment_tpu.search.tpe import TPE
+    from fast_autoaugment_tpu.search.tta import (
+        eval_tta,
+        eval_tta_batched,
+        make_tta_step,
+    )
+
+    model_type = os.environ.get("FAA_BENCH_TTA_MODEL", "wresnet10_1")
+    img = int(os.environ.get("FAA_BENCH_TTA_IMG", 8))
+    batch = int(os.environ.get("FAA_BENCH_TTA_BATCH", 1))
+    num_policy, num_op, n_sub = 1, 1, 1
+    if trials_per_k is None:
+        trials_per_k = max(
+            max(ks), int(os.environ.get("FAA_BENCH_TTA_TRIALS", 192)))
+    repeats = max(1, int(os.environ.get("FAA_BENCH_TTA_REPEATS", 3)))
+
+    model = get_model({"type": model_type}, 10)
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (batch, img, img, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, (batch,), np.int32)
+    mask = np.ones(batch, np.float32)
+    batches = [{"x": jnp.asarray(images), "y": jnp.asarray(labels),
+                "m": jnp.asarray(mask)}]
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, img, img, 3), jnp.float32),
+        train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    space = make_search_space(n_sub, num_op)
+    tmpdir = tempfile.mkdtemp(prefix="faa_tta_bench_")
+    trials_path = os.path.join(tmpdir, "search_trials.json")
+    key_fold = jax.random.PRNGKey(7)
+
+    def run_rounds(k, n_trials, step):
+        """The phase-2 inner loop at candidate-batch k; returns seconds."""
+        tpe = TPE(space, seed=0, n_startup=5)
+        trial_log = []
+        t0 = time.perf_counter()
+        done = 0
+        while done < n_trials:
+            if k == 1:
+                proposal = tpe.suggest()
+                policy_t = jnp.asarray(policy_to_tensor(
+                    policy_decoder(proposal, n_sub, num_op)))
+                m = eval_tta(step, params, batch_stats, batches, policy_t,
+                             jax.random.fold_in(key_fold, done))
+                tpe.tell(proposal, m["top1_valid"])
+                trial_log.append((proposal, m["top1_valid"]))
+            else:
+                proposals = tpe.ask(k)
+                policies_t = jnp.asarray(np.stack([
+                    np.asarray(policy_to_tensor(
+                        policy_decoder(p, n_sub, num_op)), np.float32)
+                    for p in proposals
+                ]))
+                keys = jnp.stack([jax.random.fold_in(key_fold, done + i)
+                                  for i in range(k)])
+                ms = eval_tta_batched(step, params, batch_stats, batches,
+                                      policies_t, keys)
+                rewards = [m["top1_valid"] for m in ms]
+                tpe.tell_batch(proposals, rewards)
+                trial_log.extend(zip(proposals, rewards))
+            # the driver's per-round durability write (fsync + rename)
+            write_json_atomic(trials_path, {"0": trial_log})
+            done += k
+        return time.perf_counter() - t0, done
+
+    out = {"probe": {"model": model_type, "image": img, "batch": batch,
+                     "num_policy": num_policy, "num_sub": n_sub,
+                     "trials_per_k": trials_per_k},
+           "trials_per_sec": {}}
+    for k in ks:
+        t_c = time.perf_counter()
+        step = make_tta_step(model, num_policy=num_policy, cutout_length=0,
+                             num_candidates=None if k == 1 else k)
+        # warm-up round: compile lands here, outside the timed loop
+        run_rounds(k, k, step)
+        compile_s = time.perf_counter() - t_c
+        # best of `repeats`: the least-contended window is the honest
+        # scheduler rate on a shared host (the stamp records the load)
+        rate, done = 0.0, 0
+        for _ in range(repeats):
+            dt, done = run_rounds(k, trials_per_k, step)
+            rate = max(rate, done / dt)
+        out["trials_per_sec"][str(k)] = round(rate, 2)
+        _log(f"tta scheduler K={k}: {rate:.1f} trials/s best-of-{repeats} "
+             f"({done} trials/repeat; compile+warm {compile_s:.1f}s)")
+    base = out["trials_per_sec"].get("1")
+    top = out["trials_per_sec"].get(str(max(ks)))
+    if base and top:
+        out["speedup_max_k_vs_1"] = round(top / base, 2)
+    return out
+
+
 def main():
+    # stamp BEFORE any compile ramps our own load into the 1-min average
+    contention = refuse_or_flag_contention(host_contention_stamp())
     _ensure_live_backend(
         # plumbing heartbeat only — keep the CPU run small
         fallback_env={
@@ -275,7 +458,20 @@ def main():
         "images_per_sec_hostfeed": round(hostfeed, 1) if hostfeed else None,
         "batch_per_device": BATCH_PER_DEVICE,
         "devices": n_dev,
+        "contention": contention,
     }
+
+    # search-scheduler throughput: trials/sec at --trial-batch K
+    # (FAA_BENCH_TTA=0 skips; see bench_tta_scheduler docstring)
+    if os.environ.get("FAA_BENCH_TTA", "1") != "0":
+        try:
+            tta = bench_tta_scheduler()
+            out["tta_trials_per_sec"] = tta["trials_per_sec"]
+            out["tta_bench"] = {k: v for k, v in tta.items()
+                                if k != "trials_per_sec"}
+        except Exception as e:  # noqa: BLE001 — never sink the headline
+            _log(f"tta scheduler bench failed: {e}")
+            out["tta_trials_per_sec"] = None
     latest_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "docs", "bench_tpu_latest.json")
     if os.environ.get("FAA_BENCH_CPU_FALLBACK"):

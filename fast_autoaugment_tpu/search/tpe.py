@@ -20,7 +20,11 @@ so the optimizer lives in-tree:
 - n_ei_candidates (default 24) proposals scored per suggestion.
 
 Deterministic given the seed.  Ask-tell interface so the caller owns
-the evaluation loop (and can batch/shard it across hosts).
+the evaluation loop (and can batch/shard it across hosts):
+``suggest``/``tell`` for the sequential loop, ``ask(n)``/``tell_batch``
+for synchronous batches of n concurrent proposals (constant-liar
+posterior; ``ask(1)`` is bit-for-bit ``suggest``), which the driver
+evaluates in ONE vmapped TTA program per batch (``--trial-batch``).
 """
 
 from __future__ import annotations
@@ -137,8 +141,56 @@ class TPE:
                 proposal[d.name] = float(cands[int(np.argmax(lg - lb))])
         return proposal
 
+    # ------------------------------------------------------------------
+    def ask(self, n: int = 1) -> list[dict]:
+        """Propose `n` candidates for CONCURRENT evaluation.
+
+        ``ask(1)`` is exactly one :meth:`suggest` call — same RNG
+        stream, same proposal — so a batch-1 ask/tell loop reproduces
+        the sequential loop bit-for-bit.  For ``n > 1`` the proposals
+        are generated by the CONSTANT-LIAR strategy (Ginsbourger et
+        al.'s kriging-believer family, the standard synchronous-batch
+        adaptation of sequential model-based search): after each
+        proposal a pessimistic placeholder reward — the worst
+        observation so far — is told to a TEMPORARY copy of the
+        posterior, pushing the next proposal away from the still-
+        pending point; the lies are discarded before returning.  The
+        liar value is the conservative choice for maximization: an
+        optimistic lie would cluster the whole batch on one mode.
+
+        While the batch stays inside the random-startup phase the lies
+        change nothing (the proposals are prior draws), matching
+        batched random search; a batch that CROSSES the startup
+        boundary switches to the (liar-informed) posterior mid-batch,
+        exactly as the sequential loop would switch at that count.
+        """
+        if n <= 1:
+            return [self.suggest()]
+        lie = (min(r for _, r in self.observations)
+               if self.observations else 0.0)
+        proposals: list[dict] = []
+        n_real = len(self.observations)
+        try:
+            for _ in range(n):
+                p = self.suggest()
+                proposals.append(p)
+                self.observations.append((dict(p), lie))
+        finally:
+            # drop the lies, never the real observations
+            del self.observations[n_real:]
+        return proposals
+
     def tell(self, x: dict, reward: float):
         self.observations.append((dict(x), float(reward)))
+
+    def tell_batch(self, xs: Sequence[dict], rewards: Sequence[float]):
+        """Record the true rewards for a completed :meth:`ask` batch."""
+        xs, rewards = list(xs), list(rewards)
+        if len(xs) != len(rewards):
+            raise ValueError(
+                f"tell_batch: {len(xs)} proposals vs {len(rewards)} rewards")
+        for x, r in zip(xs, rewards):
+            self.tell(x, r)
 
     @property
     def best(self):

@@ -27,11 +27,32 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
 
-__all__ = ["make_tta_step", "make_audit_step", "eval_tta"]
+__all__ = ["make_tta_step", "make_audit_step", "eval_tta", "eval_tta_batched"]
+
+
+def _jit_with_trace_counter(fn):
+    """jit `fn` with an explicit trace-event counter attached.
+
+    Each retrace of a jitted function corresponds to one new executable
+    in its compile cache (a cache hit never re-traces), so counting
+    trace events is a public-API-only census of compiles — the fallback
+    :func:`search.census.executable_census` uses when jit's private
+    ``_cache_size`` disappears in a jax upgrade.  The counter fires at
+    trace time only; it costs nothing on the steady-state call path."""
+    events: list = []
+
+    def counted(*args, **kwargs):
+        events.append(1)  # trace-time side effect: once per (re)lowering
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(counted)
+    jitted._faa_trace_count = lambda: len(events)
+    return jitted
 
 
 def _default_augment_fn(cutout_length: int) -> Callable:
@@ -43,19 +64,34 @@ def _default_augment_fn(cutout_length: int) -> Callable:
 
 
 def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
-                  augment_fn: Callable | None = None):
+                  augment_fn: Callable | None = None,
+                  num_candidates: int | None = None):
     """Build the jitted TTA evaluation step.
 
-    Returns ``fn(params, batch_stats, images_u8, labels, mask, policy,
-    key) -> {"minus_loss_sum", "correct_sum", "cnt"}`` where `policy`
-    is a [num_sub, num_op, 3] tensor applied `num_policy` times with
+    With ``num_candidates=None`` (default) returns
+    ``fn(params, batch_stats, images_u8, labels, mask, policy, key) ->
+    {"minus_loss_sum", "correct_sum", "cnt"}`` where `policy` is a
+    [num_sub, num_op, 3] tensor applied `num_policy` times with
     independent randomness.
+
+    With ``num_candidates=K`` the step gains a LEADING CANDIDATE AXIS:
+    `policy` becomes a [K, num_sub, num_op, 3] tensor of K independent
+    TPE proposals and `key` a [K]-stack of per-candidate PRNG keys; the
+    candidate axis is a vmap over the exact single-candidate
+    computation, so the K*P*B forwards run as ONE device program and
+    every returned field carries a leading [K] (including the
+    batch-global min-loss errata, which stays global PER CANDIDATE).
+    Candidate k's results are bit-identical to evaluating its
+    (policy[k], key[k]) through the single-candidate step — the Podracer
+    fan-out (arXiv:2104.06272): homogeneous trials feed the device as
+    one batch.  For either variant, one fixed argument shape = one
+    executable for the whole search (the zero-recompile invariant;
+    census via ``search.census.executable_census``).
     """
     if augment_fn is None:
         augment_fn = _default_augment_fn(cutout_length)
 
-    @jax.jit
-    def tta_step(params, batch_stats, images, labels, mask, policy, key):
+    def one_candidate(params, batch_stats, images, labels, mask, policy, key):
         keys = jax.random.split(key, num_policy)
 
         def one_draw(k):
@@ -91,7 +127,17 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
             "cnt": mask.sum().astype(jnp.float32),
         }
 
-    return tta_step
+    if num_candidates is None:
+        return _jit_with_trace_counter(one_candidate)
+
+    def tta_step_batched(params, batch_stats, images, labels, mask,
+                         policies, keys):
+        return jax.vmap(
+            lambda pol, k: one_candidate(
+                params, batch_stats, images, labels, mask, pol, k)
+        )(policies, keys)
+
+    return _jit_with_trace_counter(tta_step_batched)
 
 
 def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
@@ -113,7 +159,6 @@ def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
     if augment_fn is None:
         augment_fn = _default_augment_fn(cutout_length)
 
-    @jax.jit
     def audit_step(params, batch_stats, images, labels, mask, subs, key):
         s = subs.shape[0]
         keys = jax.random.split(key, s * num_policy).reshape(s, num_policy, 2)
@@ -135,7 +180,7 @@ def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
             "cnt": mask.sum().astype(jnp.float32),
         }
 
-    return audit_step
+    return _jit_with_trace_counter(audit_step)
 
 
 def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
@@ -163,3 +208,46 @@ def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
         "top1_mean": acc["correct_mean_sum"] / cnt if cnt else 0.0,
         "cnt": cnt,
     }
+
+
+def eval_tta_batched(tta_step_k, params, batch_stats, batches, policies,
+                     keys) -> list[dict]:
+    """Batched counterpart of :func:`eval_tta`: K candidate policies
+    through a ``make_tta_step(num_candidates=K)`` step in one device
+    program per batch.
+
+    `policies` is [K, num_sub, num_op, 3]; `keys` is a [K]-stack of
+    per-candidate TRIAL keys.  Candidate k's per-batch key is
+    ``fold_in(keys[k], batch_idx)`` — exactly what a sequential
+    :func:`eval_tta` call with ``key=keys[k]`` derives — so each entry
+    of the returned list is numerically identical to evaluating that
+    candidate alone.  One host sync per batch serves all K candidates
+    (the sequential loop pays it K times)."""
+    sums: dict[str, np.ndarray] | None = None
+    for i, batch in enumerate(batches):
+        batch_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, i))(keys)
+        out = tta_step_k(
+            params, batch_stats, batch["x"], batch["y"], batch["m"],
+            policies, batch_keys,
+        )
+        # accumulate at native f32 on the host: the same sequential
+        # f32 additions eval_tta's Accumulator performs on device, so
+        # batched == sequential holds bit-for-bit across batches too
+        out = {k: np.asarray(v) for k, v in out.items()}
+        sums = out if sums is None else {
+            k: sums[k] + out[k] for k in sums
+        }
+    if sums is None:
+        k_dim = int(policies.shape[0])
+        sums = {f: np.zeros(k_dim) for f in
+                ("minus_loss_sum", "correct_sum", "correct_mean_sum", "cnt")}
+    results = []
+    for k in range(int(sums["cnt"].shape[0])):
+        cnt = float(sums["cnt"][k])
+        results.append({
+            "minus_loss": float(sums["minus_loss_sum"][k]) / cnt if cnt else 0.0,
+            "top1_valid": float(sums["correct_sum"][k]) / cnt if cnt else 0.0,
+            "top1_mean": float(sums["correct_mean_sum"][k]) / cnt if cnt else 0.0,
+            "cnt": cnt,
+        })
+    return results

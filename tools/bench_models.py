@@ -127,8 +127,13 @@ def main(argv=None):
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
 
-    from bench import _ensure_live_backend  # dead-tunnel guard (bench.py)
+    from bench import (  # dead-tunnel guard + load provenance (bench.py)
+        _ensure_live_backend,
+        host_contention_stamp,
+        refuse_or_flag_contention,
+    )
 
+    contention = refuse_or_flag_contention(host_contention_stamp())
     _ensure_live_backend(
         reexec_argv=[sys.executable, os.path.abspath(__file__), *sys.argv[1:]]
     )
@@ -156,6 +161,7 @@ def main(argv=None):
             row = {"model": name, "error": str(e).splitlines()[0][:200]}
         if cpu_fallback:
             row["backend"] = "cpu-fallback"  # never masquerades as TPU
+        row["contention"] = contention  # busy-host captures stay visible
         rows.append(row)
         print(json.dumps(row), flush=True)
 

@@ -94,6 +94,8 @@ def main(argv=None) -> int:
     ms_per_call = steady / args.calls * 1e3
     # each call forwards batch x num_policy augmented images
     imgs_per_sec = args.batch * args.num_policy * args.calls / steady
+    from bench import host_contention_stamp
+
     summary = {
         "backend": platform,
         "device_kind": getattr(dev, "device_kind", platform),
@@ -105,6 +107,9 @@ def main(argv=None) -> int:
         "tta_ms_per_call": round(ms_per_call, 3),
         "tta_images_per_sec": round(imgs_per_sec, 1),
         "unix_time": time.time(),
+        # loadavg/process provenance: a busy-host capture must be
+        # visible in the artifact itself (VERDICT r5 weak 1)
+        "contention": host_contention_stamp(),
     }
     line = json.dumps(summary)
     print(line)
