@@ -1,7 +1,16 @@
-.PHONY: test native bench clean reproduce
+# test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
+SHELL := /bin/bash
+
+.PHONY: test test-t1 native bench clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
+
+# the tier-1 verify command, verbatim from ROADMAP.md (the plain `test`
+# target differs: it includes slow-marked tests and stops on collection
+# errors) — this is the gate the driver actually runs
+test-t1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # real-data fire-drill (VERDICT r3, next-step 8): fetch CIFAR-10 with
 # md5 verification, train WRN-40-2 + fa_reduced_cifar10 at the headline

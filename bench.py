@@ -335,6 +335,109 @@ def bench_tta_scheduler(ks=(1, 4, 16), trials_per_k=None) -> dict:
     return out
 
 
+def bench_fold_stack(num_folds=5, steps=None) -> dict:
+    """Phase-1 scheduler throughput: fold-train steps/sec at
+    ``--fold-stack {0, K}``.
+
+    Runs a faithful miniature of phase-1 fold pretraining — the real
+    jitted train step (`make_train_step`) vs the real fold-stacked step
+    (`make_stacked_train_step`, K whole learner replicas vmapped into
+    one program) on K independent states — at a tiny probe shape
+    (`FAA_BENCH_FS_MODEL` @ `FAA_BENCH_FS_IMG` px, batch
+    `FAA_BENCH_FS_BATCH`) so the per-step FIXED costs the stacked
+    scheduler amortizes (K per-fold program dispatches per step -> one)
+    are visible next to the device math.  The unit is FOLD-steps/sec:
+    one stacked call counts K.  On a TPU the same amortization applies
+    PLUS the K-model batch actually fills the MXU — the CPU number is a
+    lower bound on the scheduling win, exactly as `bench_tta_scheduler`
+    is for phase 2.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fast_autoaugment_tpu.models import get_model
+    from fast_autoaugment_tpu.ops.optim import build_optimizer
+    from fast_autoaugment_tpu.train.steps import (
+        create_train_state,
+        make_stacked_train_step,
+        make_train_step,
+        stack_states,
+    )
+
+    model_type = os.environ.get("FAA_BENCH_FS_MODEL", "wresnet10_1")
+    img = int(os.environ.get("FAA_BENCH_FS_IMG", 8))
+    batch = int(os.environ.get("FAA_BENCH_FS_BATCH", 4))
+    if steps is None:
+        steps = max(1, int(os.environ.get("FAA_BENCH_FS_STEPS", 30)))
+    repeats = max(1, int(os.environ.get("FAA_BENCH_FS_REPEATS", 3)))
+
+    model = get_model({"type": model_type}, 10)
+    opt_conf = {"type": "sgd", "decay": 2e-4, "clip": 5.0, "momentum": 0.9,
+                "nesterov": True}
+    sample = jnp.zeros((2, img, img, 3), jnp.float32)
+    kw = dict(num_classes=10, cutout_length=0, use_policy=False)
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (num_folds, batch, img, img, 3),
+                          dtype=np.uint8)
+    labels = rng.integers(0, 10, (num_folds, batch), np.int32)
+    pol = jnp.zeros((1, 1, 3), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(k) for k in range(num_folds)])
+    active = jnp.ones((num_folds,), jnp.float32)
+
+    def fresh_states():
+        opt = build_optimizer(opt_conf, lambda s: 0.05)
+        return [create_train_state(model, opt, jax.random.PRNGKey(k), sample,
+                                   use_ema=False) for k in range(num_folds)]
+
+    out = {"probe": {"model": model_type, "image": img, "batch": batch,
+                     "num_folds": num_folds, "steps": steps},
+           "steps_per_sec": {}}
+
+    # sequential: one program per (fold, step) — today's phase-1 loop
+    opt = build_optimizer(opt_conf, lambda s: 0.05)
+    seq_step = make_train_step(model, opt, **kw)
+    states = fresh_states()
+    xs = [jnp.asarray(images[k]) for k in range(num_folds)]
+    ys = [jnp.asarray(labels[k]) for k in range(num_folds)]
+    for k in range(num_folds):  # compile + warm outside the timed loop
+        states[k], _ = seq_step(states[k], xs[k], ys[k], pol, keys[k])
+    jax.block_until_ready(states[0].params)
+    rate = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            for k in range(num_folds):
+                states[k], _ = seq_step(states[k], xs[k], ys[k], pol, keys[k])
+        jax.block_until_ready(states[0].params)
+        rate = max(rate, steps * num_folds / (time.perf_counter() - t0))
+    out["steps_per_sec"]["0"] = round(rate, 2)
+    _log(f"fold-stack K=0 (sequential): {rate:.1f} fold-steps/s "
+         f"best-of-{repeats}")
+
+    # stacked: K folds per program — the --fold-stack K scheduler
+    opt = build_optimizer(opt_conf, lambda s: 0.05)
+    st_step = make_stacked_train_step(model, opt, **kw)
+    stacked = stack_states(fresh_states())
+    xst, yst = jnp.asarray(images), jnp.asarray(labels)
+    stacked, _ = st_step(stacked, xst, yst, pol, keys, active)
+    jax.block_until_ready(stacked.params)
+    rate = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            stacked, _ = st_step(stacked, xst, yst, pol, keys, active)
+        jax.block_until_ready(stacked.params)
+        rate = max(rate, steps * num_folds / (time.perf_counter() - t0))
+    out["steps_per_sec"][str(num_folds)] = round(rate, 2)
+    _log(f"fold-stack K={num_folds} (stacked): {rate:.1f} fold-steps/s "
+         f"best-of-{repeats}")
+    base = out["steps_per_sec"]["0"]
+    top = out["steps_per_sec"][str(num_folds)]
+    if base and top:
+        out["speedup_stacked_vs_sequential"] = round(top / base, 2)
+    return out
+
+
 def main():
     # stamp BEFORE any compile ramps our own load into the 1-min average
     contention = refuse_or_flag_contention(host_contention_stamp())
@@ -472,6 +575,19 @@ def main():
         except Exception as e:  # noqa: BLE001 — never sink the headline
             _log(f"tta scheduler bench failed: {e}")
             out["tta_trials_per_sec"] = None
+
+    # phase-1 scheduler throughput: fold-train steps/sec at
+    # --fold-stack {0, K} (FAA_BENCH_FOLD_STACK=0 skips) — tracks the
+    # fold-stacking win the way tta_trials_per_sec tracks trial batching
+    if os.environ.get("FAA_BENCH_FOLD_STACK", "1") != "0":
+        try:
+            fs = bench_fold_stack()
+            out["fold_stack_steps_per_sec"] = fs["steps_per_sec"]
+            out["fold_stack_bench"] = {k: v for k, v in fs.items()
+                                       if k != "steps_per_sec"}
+        except Exception as e:  # noqa: BLE001 — never sink the headline
+            _log(f"fold-stack bench failed: {e}")
+            out["fold_stack_steps_per_sec"] = None
     latest_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "docs", "bench_tpu_latest.json")
     if os.environ.get("FAA_BENCH_CPU_FALLBACK"):

@@ -27,7 +27,8 @@ import numpy as np
 
 from fast_autoaugment_tpu.data.datasets import ArrayDataset
 
-__all__ = ["BatchIterator", "train_batches", "eval_batches", "prefetch"]
+__all__ = ["BatchIterator", "train_batches", "stacked_train_batches",
+           "eval_batches", "prefetch"]
 
 
 def _decode(paths: np.ndarray, transform=None, size: int | None = None) -> np.ndarray:
@@ -148,6 +149,86 @@ def train_batches(
             else:
                 images = _decode(images, transform, decode_size)
         yield images, dataset.labels[chunk]
+
+
+def stacked_train_batches(
+    dataset: ArrayDataset,
+    fold_indices: list,
+    global_batch: int,
+    epoch: int,
+    *,
+    seeds: list,
+    process_index: int = 0,
+    process_count: int = 1,
+    decode_size: int | None = None,
+    host_transform=None,
+    box_fn=None,
+    imgsize: int | None = None,
+    size_cache: "SizeCache | None" = None,
+) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Multiplexed per-fold train feed for the fold-stacked trainer.
+
+    Yields ``(images [K, S, H, W, C], labels [K, S], active [K])`` per
+    step, where fold k's index stream is EXACTLY what
+    :func:`train_batches` would yield for ``(fold_indices[k],
+    seeds[k])`` — the same ``default_rng((seed, epoch))`` permutation,
+    the same drop-last step count, the same per-process contiguous
+    shard — so stacked training consumes bit-identical per-fold batches.
+    The K folds share the underlying dataset; only the cv-split index
+    sets differ, so for in-memory datasets the whole multiplex is one
+    fancy-gather per step and nothing is ever copied per fold on the
+    host beyond the gathered batch itself.
+
+    Folds whose epoch is exhausted (shorter index sets) go
+    ``active=0``: their lane repeats wrapped filler indices so the
+    stacked shape never changes (one executable per shape downstream),
+    and the consumer masks the lane out.
+
+    Lazy (on-disk) datasets decode the per-step UNION of the K fold
+    chunks once — an image drawn by several folds in the same step
+    decodes a single time.  Deliberate deviation from per-fold
+    sequential decoding: the decode rng is a fresh per-epoch stream
+    rather than fold k's private stream, so host-side random crop boxes
+    are equally-distributed fresh draws, not bit-identical ones (the
+    device-side augmentation keys are unaffected; they ride with the
+    train step).  The stacked phase-1 driver path gates lazy datasets
+    out for exactly this reason.
+    """
+    num_folds = len(fold_indices)
+    if len(seeds) != num_folds:
+        raise ValueError(f"{len(seeds)} seeds for {num_folds} folds")
+    rng = np.random.default_rng((int(seeds[0]), epoch, 971))  # lazy decode only
+    perms, steps = [], []
+    for k in range(num_folds):
+        idx = np.asarray(fold_indices[k])
+        perms.append(np.random.default_rng((seeds[k], epoch)).permutation(idx))
+        steps.append(len(idx) // global_batch)
+    shard = global_batch // process_count
+    transform = None
+    if host_transform is not None:
+        transform = lambda img: host_transform(img, rng)  # noqa: E731
+    for s in range(max(steps, default=0)):
+        active = np.asarray([s < n for n in steps], np.float32)
+        chunks = []
+        for k in range(num_folds):
+            if s < steps[k]:
+                chunk = perms[k][s * global_batch:(s + 1) * global_batch]
+            else:  # exhausted lane: wrapped filler, masked out by `active`
+                chunk = np.resize(perms[k], global_batch)
+            chunks.append(chunk[process_index * shard:(process_index + 1) * shard])
+        chunks = np.stack(chunks)  # [K, S]
+        if dataset.lazy:
+            flat_paths = dataset.images[chunks.reshape(-1)]
+            uniq, inverse = np.unique(flat_paths, return_inverse=True)
+            if box_fn is not None:
+                decoded = _decode_boxed(uniq, imgsize, box_fn, rng,
+                                        size_cache or SizeCache())
+            else:
+                decoded = _decode(uniq, transform, decode_size)
+            images = decoded[inverse].reshape(chunks.shape + decoded.shape[1:])
+        else:
+            images = dataset.images[chunks]
+        yield images, dataset.labels[chunks], active
 
 
 def eval_batches(
@@ -274,6 +355,16 @@ def prefetch(iterator, depth: int | None = None, transform=None):
         except BaseException as e:  # propagate into the consumer
             err.append(e)
         finally:
+            # close the SOURCE generator from the worker (its owning
+            # thread): an abandoned consumer otherwise leaves the
+            # source suspended until GC, holding its resources open
+            close = getattr(iterator, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except BaseException as e:  # noqa: BLE001
+                    if not err:
+                        err.append(e)
             put(_END)
 
     threading.Thread(target=worker, daemon=True).start()

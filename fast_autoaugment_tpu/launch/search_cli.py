@@ -45,6 +45,23 @@ def _quality_floor_arg(value: str) -> str:
     return value
 
 
+def _fold_stack_arg(value: str) -> "str | int":
+    """Validate ``--fold-stack`` at parse time: '0' (sequential,
+    bit-for-bit the pre-stacking path), 'auto' (stack every fold that
+    needs training), or an int K >= 2 (stack width cap)."""
+    if value.lower() == "auto":
+        return "auto"
+    try:
+        k = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or an integer, got {value!r}")
+    if k < 0:
+        raise argparse.ArgumentTypeError(
+            f"expected a non-negative stack width, got {value!r}")
+    return k
+
+
 def random_arm_skip_reason(result: dict) -> str | None:
     """Why a requested --phase3-random control arm cannot run, or None.
 
@@ -86,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's 80 concurrent Ray trials, "
                         "search.py:230).  1 (default) = the sequential "
                         "scheduler, bit-for-bit")
+    p.add_argument("--fold-stack", default=0, type=_fold_stack_arg,
+                   help="phase-1 fold stacking: train K fold models as "
+                        "ONE vmapped program per step, folds sharded "
+                        "onto the mesh data axis when the counts divide "
+                        "(the phase-1 counterpart of --trial-batch).  "
+                        "0 (default) = the sequential per-fold loop "
+                        "bit-for-bit; 'auto' stacks every fold needing "
+                        "training; K caps the stack width")
     p.add_argument("--num-result-per-cv", type=int, default=5,
                    help="phase-3 retrains per mode (reference search.py:270)")
     p.add_argument("--until", type=int, default=3,
@@ -149,6 +174,7 @@ def main(argv=None):
         audit_floor=args.audit_floor if args.audit_floor > 0 else None,
         random_control=args.phase3_random,
         trial_batch=args.trial_batch,
+        fold_stack=args.fold_stack,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []

@@ -25,16 +25,21 @@ statistics (or ``lax.pmean`` with an axis_name under shard_map).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "make_mesh",
+    "make_fold_mesh",
     "data_sharding",
     "replicated",
     "shard_batch",
+    "shard_stacked_batch",
     "shard_transform",
+    "stacked_shard_transform",
     "distributed_init",
     "local_batch_to_global",
 ]
@@ -49,6 +54,36 @@ def make_mesh(devices=None, axis_name: str = "data") -> Mesh:
     """
     devices = np.asarray(devices if devices is not None else jax.devices())
     return Mesh(devices.reshape(-1), (axis_name,))
+
+
+def make_fold_mesh(num_folds: int, devices=None, *,
+                   fold_shards: int | None = None,
+                   fold_axis: str = "fold", data_axis: str = "data") -> Mesh:
+    """2-D ``(fold, data)`` mesh for the fold-stacked phase-1 trainer.
+
+    The fold-to-mesh mapping rule: the fold axis takes
+    ``gcd(num_folds, n_devices)`` shards by default, the data axis the
+    rest.  With devices >= K (and K | n_devices) every fold owns a
+    disjoint device group — folds are SHARDED across the machine
+    instead of replicated onto every device; with one device (or
+    coprime counts) the fold axis stays unsharded and stacking is pure
+    program fusion.  Each fold's per-fold global batch is
+    ``batch_per_device x (n_devices / fold_shards)`` — exactly the
+    global batch a sequential run restricted to that fold's device
+    group would use, which is what keeps the seeded stacked-vs-
+    sequential equivalence well-defined at any layout (pass
+    ``fold_shards=1`` to reproduce the all-devices-per-fold sequential
+    semantics bit-for-bit).
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if fold_shards is None:
+        fold_shards = math.gcd(int(num_folds), n)
+    if fold_shards < 1 or n % fold_shards:
+        raise ValueError(
+            f"fold_shards={fold_shards} does not divide {n} devices")
+    return Mesh(devices.reshape(fold_shards, n // fold_shards),
+                (fold_axis, data_axis))
 
 
 def data_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
@@ -78,6 +113,45 @@ def shard_batch(mesh: Mesh, batch, axis_name: str = "data"):
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree.map(put, batch)
+
+
+def shard_stacked_batch(mesh: Mesh, batch, fold_axis: str = "fold",
+                        data_axis: str = "data"):
+    """Place a stacked ``{x: [K,B,...], y: [K,B], a: [K]}`` batch on a
+    :func:`make_fold_mesh` mesh: the leading fold axis maps onto the
+    mesh's fold axis, the per-fold batch dim onto the data axis, and
+    rank-1 fold-aligned tensors (the active mask) ride the fold axis
+    alone.  Multi-host: each process passes its per-fold LOCAL batch
+    shard (dim 1), mirroring :func:`shard_batch`."""
+    def spec(x):
+        return P(fold_axis, data_axis) if x.ndim >= 2 else P(fold_axis)
+
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, spec(x))), batch)
+
+    def put(x):
+        global_shape = x.shape
+        if x.ndim >= 2:
+            global_shape = (x.shape[0], x.shape[1] * jax.process_count(),
+                            ) + x.shape[2:]
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec(x)), x, global_shape)
+
+    return jax.tree.map(put, batch)
+
+
+def stacked_shard_transform(mesh: Mesh, keys=("x", "y", "a"),
+                            fold_axis: str = "fold",
+                            data_axis: str = "data"):
+    """`transform=` hook for ``prefetch`` over
+    :func:`data.pipeline.stacked_train_batches` tuples — the stacked
+    analog of :func:`shard_transform`."""
+    def transform(item):
+        return shard_stacked_batch(
+            mesh, dict(zip(keys, item, strict=True)), fold_axis, data_axis)
+
+    return transform
 
 
 def shard_transform(mesh: Mesh, keys=("x", "y"), axis_name: str = "data"):

@@ -38,7 +38,15 @@ from fast_autoaugment_tpu.core.metrics import (
 from fast_autoaugment_tpu.ops.optim import ema_update
 from fast_autoaugment_tpu.ops.preprocess import cifar_eval_batch, cifar_train_batch
 
-__all__ = ["TrainState", "create_train_state", "make_train_step", "make_eval_step"]
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_stacked_train_step",
+    "make_eval_step",
+    "stack_states",
+    "slice_state",
+]
 
 
 class TrainState(struct.PyTreeNode):
@@ -72,7 +80,7 @@ def create_train_state(model, optimizer, rng, sample_input, use_ema: bool) -> Tr
     )
 
 
-def make_train_step(
+def _make_train_step_body(
     model,
     optimizer,
     *,
@@ -84,11 +92,18 @@ def make_train_step(
     use_policy: bool = True,
     augment_fn: Callable | None = None,
 ) -> Callable:
-    """Build the jitted train step.
-
-    Returns ``step_fn(state, images_u8, labels, policy, key) ->
-    (state, metric_sums)``.  `augment_fn(images, policy, key)` defaults
-    to the CIFAR/SVHN stack; pass an ImageNet stack for that family.
+    """The UNJITTED per-model train-step body shared by the sequential
+    and fold-stacked variants: :func:`make_train_step` jits it directly;
+    :func:`make_stacked_train_step` vmaps the identical computation over
+    a leading fold axis — the candidate-axis construction of
+    ``search/tta.py::make_tta_step``, applied to phase 1.  Unlike the
+    eval-only TTA step, a TRAIN step under vmap lowers to batched
+    conv/matmul kernels whose reduction order can differ from the
+    unbatched ones by ~1 float32 ULP per step (measured; see
+    ``train_folds_stacked``), so stacked equality with sequential
+    training is ULP-exact per step but only tolerance-bounded over a
+    full run — the same deviation class as the repo's documented
+    single-vs-multi-device drift (tests/test_train.py).
     """
     if augment_fn is None:
         def augment_fn(images, policy, key):
@@ -115,9 +130,6 @@ def make_train_step(
             loss = smooth_cross_entropy(logits, labels, lb_smooth)
         return loss, (logits, mutated["batch_stats"])
 
-    # donate the state: params/opt-state/EMA buffers are overwritten in
-    # place, halving peak HBM for the update
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def step_fn(state: TrainState, images, labels, policy, key):
         key_aug, key_model = jax.random.split(jax.random.fold_in(key, state.step))
         images = augment_fn(images, policy, key_aug)
@@ -155,6 +167,104 @@ def make_train_step(
         return new_state, metrics
 
     return step_fn
+
+
+def make_train_step(
+    model,
+    optimizer,
+    *,
+    num_classes: int,
+    mixup_alpha: float = 0.0,
+    lb_smooth: float = 0.0,
+    ema_mu: float = 0.0,
+    cutout_length: int = 16,
+    use_policy: bool = True,
+    augment_fn: Callable | None = None,
+) -> Callable:
+    """Build the jitted train step.
+
+    Returns ``step_fn(state, images_u8, labels, policy, key) ->
+    (state, metric_sums)``.  `augment_fn(images, policy, key)` defaults
+    to the CIFAR/SVHN stack; pass an ImageNet stack for that family.
+    """
+    body = _make_train_step_body(
+        model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
+        lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
+        use_policy=use_policy, augment_fn=augment_fn,
+    )
+    # donate the state: params/opt-state/EMA buffers are overwritten in
+    # place, halving peak HBM for the update
+    return functools.partial(jax.jit, donate_argnums=(0,))(body)
+
+
+def make_stacked_train_step(
+    model,
+    optimizer,
+    *,
+    num_classes: int,
+    mixup_alpha: float = 0.0,
+    lb_smooth: float = 0.0,
+    ema_mu: float = 0.0,
+    cutout_length: int = 16,
+    use_policy: bool = True,
+    augment_fn: Callable | None = None,
+) -> Callable:
+    """Build the fold-stacked train step: K fold models advance in ONE
+    jitted program per step (the Podracer whole-learner-replica vmap,
+    arXiv:2104.06272, applied to phase-1 fold pretraining).
+
+    Returns ``fn(states, images_u8 [K,B,H,W,C], labels [K,B], policy,
+    keys [K,2], active [K]) -> (states, metric_sums)`` where `states` is
+    a :class:`TrainState` whose every leaf carries a leading fold axis
+    (:func:`stack_states`) and `keys` stacks the per-fold base PRNG keys
+    (fold k's per-step key is ``fold_in(keys[k], states.step[k])``
+    inside the body — exactly the sequential step's derivation).
+
+    The fold axis is a pure ``jax.vmap`` of the sequential step body:
+    fold k's update is the sequential step on its slice, computed by
+    batched kernels whose accumulation order may differ by ~1 f32 ULP
+    (the documented stacked-vs-sequential bound; module body docstring).
+    `active` (float 0/1 per fold) freezes finished lanes:
+    inactive folds still ride through the program (one executable for
+    any participation set — no recompiles when folds resume at different
+    epochs or run out of batches), but their state is passed through
+    unchanged and their metric sums are zeroed, so a masked lane is
+    indistinguishable from not having stepped at all.
+    """
+    body = _make_train_step_body(
+        model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
+        lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
+        use_policy=use_policy, augment_fn=augment_fn,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def stacked_fn(states, images, labels, policy, keys, active):
+        new_states, metrics = jax.vmap(
+            body, in_axes=(0, 0, 0, None, 0)
+        )(states, images, labels, policy, keys)
+
+        def select(new, old):
+            gate = active.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(gate > 0, new, old)
+
+        new_states = jax.tree.map(select, new_states, states)
+        metrics = {k: v * active for k, v in metrics.items()}
+        return new_states, metrics
+
+    return stacked_fn
+
+
+def stack_states(states: list[TrainState]) -> TrainState:
+    """Stack K per-fold states into one state with a leading fold axis
+    on every leaf (``ema=None`` stays None)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def slice_state(states: TrainState, fold_axis_index: int) -> TrainState:
+    """Extract fold k's unstacked state from a stacked state — the
+    checkpoint-slicing primitive (each fold saves/restores under the
+    same per-fold layout the sequential path uses)."""
+    return jax.tree.map(lambda x: x[fold_axis_index], states)
 
 
 def make_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,

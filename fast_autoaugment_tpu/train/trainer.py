@@ -34,20 +34,32 @@ from fast_autoaugment_tpu.core.checkpoint import (
 )
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
-from fast_autoaugment_tpu.data.pipeline import BatchIterator, prefetch
+from fast_autoaugment_tpu.data.pipeline import (
+    BatchIterator,
+    prefetch,
+    stacked_train_batches,
+)
 from fast_autoaugment_tpu.models import get_model, num_class
 from fast_autoaugment_tpu.ops.optim import build_optimizer
 from fast_autoaugment_tpu.ops.schedules import build_schedule
-from fast_autoaugment_tpu.parallel.mesh import make_mesh, shard_transform
+from fast_autoaugment_tpu.parallel.mesh import (
+    make_fold_mesh,
+    make_mesh,
+    shard_transform,
+    stacked_shard_transform,
+)
 from fast_autoaugment_tpu.policies.archive import load_policy, policy_to_tensor
 from fast_autoaugment_tpu.train.steps import (
     create_train_state,
     make_eval_step,
+    make_stacked_train_step,
     make_train_step,
+    slice_state,
+    stack_states,
 )
 from fast_autoaugment_tpu.utils.logging import get_logger, make_writers
 
-__all__ = ["train_and_eval", "resolve_policy_tensor"]
+__all__ = ["train_and_eval", "train_folds_stacked", "resolve_policy_tensor"]
 
 logger = get_logger("faa_tpu.train")
 
@@ -406,3 +418,278 @@ def train_and_eval(
     for w in writers:
         w.close()
     return result
+
+
+def train_folds_stacked(
+    conf,
+    dataroot: str,
+    *,
+    cv_ratio: float,
+    folds: list[int],
+    save_paths: list[str],
+    seed: int = 0,
+    seeds: list[int] | None = None,
+    evaluation_interval: int = 5,
+    mesh=None,
+    resume: bool = True,
+) -> dict[int, dict]:
+    """Train K phase-1 fold models as ONE vmapped program per step.
+
+    The fold-stacked counterpart of calling :func:`train_and_eval` once
+    per fold with ``test_ratio=cv_ratio, cv_fold=fold, metric='last'``:
+    all K fold states (params, batch_stats, opt_state, per-fold PRNG)
+    advance together through :func:`make_stacked_train_step`, fed by
+    :func:`stacked_train_batches` gathering the K per-fold shuffled
+    index streams out of the ONE shared dataset.  The fold axis is a
+    pure vmap of the sequential step body and each fold's data and key
+    streams are reproduced exactly, so the stacked computation is the
+    sequential one per fold — up to a measured ~1 f32 ULP/step kernel
+    reduction-order difference (vmap lowers to batched conv/matmul
+    kernels), which training dynamics amplify over a run exactly as the
+    repo's documented single-vs-multi-device drift is amplified
+    (tests/test_train.py::test_train_step_single_vs_eight_devices).
+    The seeded equivalence test pins the bound at short horizons and
+    checks eval-metric agreement at run end
+    (tests/test_stacked_phase1.py); docs/BENCHMARKS.md records the
+    deviation rationale.
+
+    `mesh` defaults to :func:`make_fold_mesh` over all devices — folds
+    shard across device groups when the counts divide (the per-fold
+    global batch is then ``conf['batch'] x data_axis_size``; see
+    `make_fold_mesh`).  `seeds` gives per-fold seeds (default: `seed`
+    for every fold, matching the sequential phase-1 loop).  Per-fold
+    checkpoints save/restore through :func:`slice_state` under the
+    caller-supplied paths — the same layout the sequential path writes,
+    so resume, the fold-oracle gate, and single-fold retrains consume
+    them unchanged.  Returns ``{fold: result_dict}`` with the
+    :func:`train_and_eval`-shaped per-fold metrics.
+
+    In-memory datasets only: lazy (on-disk) datasets fall back to the
+    sequential path in the search driver (per-fold host decode streams
+    cannot be multiplexed bit-for-bit; ``stacked_train_batches``
+    docstring).
+    """
+    if len(folds) != len(save_paths):
+        raise ValueError(f"{len(folds)} folds but {len(save_paths)} paths")
+    num_folds = len(folds)
+    if seeds is None:
+        seeds = [seed] * num_folds
+    if mesh is None:
+        mesh = make_fold_mesh(num_folds)
+    data_size = mesh.shape["data"]
+    is_master = jax.process_index() == 0
+    t_start = time.time()
+
+    dataset_name = conf["dataset"]
+    num_classes = num_class(dataset_name)
+    total_train, testset = load_dataset(dataset_name, dataroot)
+    if total_train.lazy:
+        raise ValueError(
+            "train_folds_stacked supports in-memory datasets only; "
+            f"{dataset_name!r} is lazy — use the sequential per-fold path")
+
+    fold_train_idx, fold_valid_idx = [], []
+    for fold in folds:
+        tr, va = cv_split(total_train.labels, cv_ratio, fold)
+        fold_train_idx.append(tr)
+        fold_valid_idx.append(va)
+
+    from fast_autoaugment_tpu.models import input_image_size
+
+    image = int(conf.get("imgsize", 0) or 0) or input_image_size(
+        dataset_name, conf["model"]["type"]
+    )
+    batch_per_device = int(conf["batch"])
+    global_batch = batch_per_device * data_size
+    for fold, tr in zip(folds, fold_train_idx):
+        if len(tr) < global_batch:
+            raise ValueError(
+                f"fold {fold} has {len(tr)} train examples < per-fold "
+                f"global batch {global_batch} — every epoch would be empty")
+    step_counts = {len(tr) // global_batch for tr in fold_train_idx}
+    if len(step_counts) != 1:
+        # the LR schedule is baked into the ONE shared optimizer as a
+        # pure function of the step; folds with different step counts
+        # need per-fold schedules the stack cannot represent
+        raise ValueError(
+            f"folds disagree on steps/epoch ({sorted(step_counts)}) — "
+            "train them sequentially instead")
+    steps_per_epoch = step_counts.pop()
+    epochs = int(conf["epoch"])
+
+    model_conf = dict(conf["model"], dataset=dataset_name)
+    model_conf.setdefault("precision", conf.get("precision", "f32"))
+    model = get_model(model_conf, num_classes)
+    lr_fn = build_schedule(conf, steps_per_epoch, world_lr_scale=float(data_size))
+    optimizer_conf = conf["optimizer"]
+    ema_mu = float(optimizer_conf.get("ema", 0.0) or 0.0)
+    optimizer = build_optimizer(optimizer_conf, lr_fn)
+
+    sample = jnp.zeros((2, image, image, 3), jnp.float32)
+    policy = resolve_policy_tensor(conf.get("aug", "default"))
+    use_policy = policy is not None
+    pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
+
+    stacked_step = make_stacked_train_step(
+        model,
+        optimizer,
+        num_classes=num_classes,
+        mixup_alpha=float(conf.get("mixup", 0.0) or 0.0),
+        lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
+        ema_mu=ema_mu,
+        cutout_length=int(conf.get("cutout", 0) or 0),
+        use_policy=use_policy,
+    )
+    eval_step = make_eval_step(
+        model, num_classes=num_classes,
+        lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
+    )
+
+    # per-fold init/restore, then one stacked state
+    states, epoch_starts = [], []
+    for k, (fold, path) in enumerate(zip(folds, save_paths)):
+        state = create_train_state(
+            model, optimizer, jax.random.PRNGKey(seeds[k]), sample,
+            use_ema=ema_mu > 0.0,
+        )
+        epoch_start = 1
+        if resume and path and checkpoint_exists(path):
+            meta = read_metadata(path) or {}
+            state = load_checkpoint(path, state)
+            epoch_start = int(meta.get("epoch", 0)) + 1
+            logger.info("stacked: resumed fold %d at epoch %d", fold,
+                        epoch_start - 1)
+        states.append(state)
+        epoch_starts.append(epoch_start)
+    stacked = stack_states(states)
+    del states
+    # shard every state leaf's leading fold axis over the mesh fold
+    # axis (a no-op layout on fold_shards=1 meshes): folds live on
+    # their own device groups instead of replicating
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    fold_placed = NamedSharding(mesh, PartitionSpec("fold"))
+    stacked = jax.device_put(stacked, fold_placed)
+    keys = jax.device_put(
+        jnp.stack([jax.random.PRNGKey(s) for s in seeds]), fold_placed)
+
+    valid_its = [BatchIterator(total_train, va) for va in fold_valid_idx]
+    test_it = BatchIterator(testset)
+    writers = [
+        make_writers(os.path.dirname(p) if p else None,
+                     os.path.basename(p or "run"), is_master)
+        for p in save_paths
+    ]
+    results: dict[int, dict] = {
+        fold: {"epoch": epoch_starts[k] - 1} for k, fold in enumerate(folds)
+    }
+
+    def evaluate_fold(k: int, state_k) -> dict:
+        out = {}
+        eval_kw = dict(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            pad_multiple=data_size,
+        )
+        for split, it in (("valid", valid_its[k]), ("test", test_it)):
+            if len(it) == 0:
+                continue
+            out[split] = _run_eval(
+                eval_step, state_k.params, state_k.batch_stats,
+                it.eval_epoch(global_batch, **eval_kw), mesh,
+            )
+        return out
+
+    first_epoch = min(epoch_starts)
+    transform = stacked_shard_transform(mesh)
+    for epoch in range(first_epoch, epochs + 1):
+        epoch_active = np.asarray(
+            [1.0 if epoch >= epoch_starts[k] else 0.0
+             for k in range(num_folds)], np.float32)
+        ep_act_dev = jnp.asarray(epoch_active)
+        batches = prefetch(
+            stacked_train_batches(
+                total_train, fold_train_idx, global_batch, epoch,
+                seeds=seeds,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            ),
+            transform=transform,
+        )
+        # per-fold sums stay DEVICE-side [K] vectors until epoch end —
+        # reading them per batch would sync the dispatch pipeline (the
+        # same discipline as the sequential epoch loop)
+        epoch_sums: dict | None = None
+        for batch in batches:
+            active = batch["a"] * ep_act_dev
+            stacked, metrics = stacked_step(
+                stacked, batch["x"], batch["y"], pol, keys, active)
+            epoch_sums = metrics if epoch_sums is None else {
+                kk: epoch_sums[kk] + metrics[kk] for kk in epoch_sums}
+        host_sums = {kk: np.asarray(v)
+                     for kk, v in (epoch_sums or {}).items()}
+
+        for k, fold in enumerate(folds):
+            if not epoch_active[k]:
+                continue
+            num = float(host_sums["num"][k]) if host_sums else 0.0
+            if num <= 0:
+                raise RuntimeError(
+                    f"stacked epoch {epoch} produced zero batches for fold "
+                    f"{fold} — feed pipeline bug")
+            train_metrics = {
+                kk: float(host_sums[kk][k]) / num
+                for kk in ("loss", "top1", "top5")}
+            train_metrics["num"] = num
+            if np.isnan(train_metrics["loss"]):
+                raise RuntimeError(
+                    f"fold {fold} loss is NaN — training diverged")
+            for kk in ("loss", "top1", "top5"):
+                writers[k][0].add_scalar(kk, train_metrics[kk], epoch)
+            logger.info(
+                "[stacked fold %d %3d/%3d] loss=%.4f top1=%.4f", fold,
+                epoch, epochs, train_metrics["loss"], train_metrics["top1"],
+            )
+            results[fold].update(
+                {f"{kk}_train": v for kk, v in train_metrics.items()
+                 if kk != "num"})
+            results[fold]["epoch"] = epoch
+
+            if epoch % evaluation_interval == 0 or epoch == epochs:
+                state_k = slice_state(stacked, k)
+                evals = evaluate_fold(k, state_k)
+                for split, m in evals.items():
+                    widx = 1 if split.startswith("valid") else 2
+                    for kk in ("loss", "top1", "top5"):
+                        writers[k][widx].add_scalar(kk, m.get(kk, 0.0), epoch)
+                    for kk, v in m.items():
+                        results[fold][f"{kk}_{split}"] = v
+                    logger.info(
+                        "[stacked fold %d %s %3d/%3d] %s", fold, split,
+                        epoch, epochs,
+                        {kk: round(float(v), 4) for kk, v in m.items()})
+                # metric='last' semantics (the phase-1 contract): every
+                # eval epoch is the new best, checkpoint it
+                results[fold]["best_valid_top1"] = evals.get(
+                    "valid", {}).get("top1", 0.0)
+                results[fold]["best_test_top1"] = evals.get(
+                    "test", {}).get("top1", 0.0)
+                if save_paths[k] and is_master:
+                    save_checkpoint(
+                        save_paths[k],
+                        state_k,
+                        {
+                            "epoch": epoch,
+                            "step": int(state_k.step),
+                            "metrics": {kk: float(v)
+                                        for kk, v in results[fold].items()
+                                        if isinstance(v, (int, float))},
+                        },
+                    )
+
+    elapsed = time.time() - t_start
+    for k, fold in enumerate(folds):
+        results[fold]["elapsed_sec"] = elapsed
+        for w in writers[k]:
+            w.close()
+    return results

@@ -30,6 +30,16 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=20)
     args = p.parse_args(argv)
 
+    # loadavg/process provenance, shared with bench.py: a busy-host
+    # capture must be visible in the output itself, and
+    # FAA_BENCH_REQUIRE_QUIET=1 refuses instead (VERDICT r5 weak 1)
+    import json
+
+    from bench import host_contention_stamp, refuse_or_flag_contention
+
+    contention = refuse_or_flag_contention(host_contention_stamp())
+    print(f"contention: {json.dumps(contention)}")
+
     import jax
     import jax.numpy as jnp
     import numpy as np

