@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 native bench clean reproduce
+.PHONY: test test-t1 native bench bench-aug clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
@@ -24,6 +24,12 @@ native:
 
 bench:
 	python bench.py
+
+# augmentation-dispatch bench: per-op table + exact vs grouped
+# aug_images_per_sec at several G, with compile-time metrics.  Honors
+# FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host, exit 3).
+bench-aug:
+	python tools/bench_aug.py
 
 clean:
 	$(MAKE) -C native clean

@@ -103,6 +103,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's 80 concurrent Ray trials, "
                         "search.py:230).  1 (default) = the sequential "
                         "scheduler, bit-for-bit")
+    p.add_argument("--aug-dispatch", default="exact",
+                   choices=("exact", "grouped"),
+                   help="policy-application kernel for phase-2 TTA, the "
+                        "sub-policy audit and phase-3 policy-on retrains. "
+                        "'exact' (default) = the historical per-image "
+                        "vmapped-switch path bit-for-bit (XLA executes all "
+                        "19 op branches per image); 'grouped' = scalar "
+                        "dispatch (one branch executes; stratified "
+                        "per-chunk sub-policy draws with identical "
+                        "per-image marginals — docs/BENCHMARKS.md "
+                        "'Augmentation dispatch')")
+    p.add_argument("--aug-groups", type=int, default=8,
+                   help="chunks per batch for --aug-dispatch grouped "
+                        "(each chunk shares one sub-policy draw)")
     p.add_argument("--fold-stack", default=0, type=_fold_stack_arg,
                    help="phase-1 fold stacking: train K fold models as "
                         "ONE vmapped program per step, folds sharded "
@@ -175,6 +189,8 @@ def main(argv=None):
         random_control=args.phase3_random,
         trial_batch=args.trial_batch,
         fold_stack=args.fold_stack,
+        aug_dispatch=args.aug_dispatch,
+        aug_groups=args.aug_groups,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []
@@ -264,6 +280,7 @@ def main(argv=None):
             res = train_and_eval(
                 mode_conf, args.dataroot, test_ratio=0.0,
                 save_path=path, metric="last", seed=seeds[run],
+                aug_dispatch=args.aug_dispatch, aug_groups=args.aug_groups,
             )
             outcomes[mode].append(float(res.get("top1_test", 0.0)))
             logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,

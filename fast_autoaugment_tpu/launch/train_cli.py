@@ -34,6 +34,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--only-eval", action="store_true")
     p.add_argument("--evaluation-interval", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--aug-dispatch", default="exact",
+                   choices=("exact", "grouped"),
+                   help="policy-application kernel: 'exact' (default) is "
+                        "the per-image vmapped-switch path bit-for-bit; "
+                        "'grouped' keeps op dispatch scalar (one lax.switch "
+                        "branch executes; stratified per-chunk sub-policy "
+                        "draws — docs/BENCHMARKS.md 'Augmentation dispatch')")
+    p.add_argument("--aug-groups", type=int, default=8,
+                   help="chunks per batch for --aug-dispatch grouped")
     p.add_argument("--coordinator", default=None, help="host0 addr for multi-host")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
@@ -66,6 +75,8 @@ def main(argv=None):
         evaluation_interval=args.evaluation_interval,
         metric="last",
         seed=args.seed,
+        aug_dispatch=args.aug_dispatch,
+        aug_groups=args.aug_groups,
     )
     elapsed = time.time() - t0
     logger.info("done %s: %s", args.tag, json.dumps(

@@ -42,13 +42,18 @@ import numpy as np
 __all__ = [
     "OP_NAMES",
     "SEARCH_OP_NAMES",
+    "AUG_DISPATCH_MODES",
     "op_index",
     "augment_list",
     "apply_augment",
     "apply_op",
     "apply_subpolicy",
+    "apply_subpolicy_batch",
     "apply_policy",
+    "apply_policy_scalar_single",
     "apply_policy_batch",
+    "apply_policy_batch_grouped",
+    "check_aug_dispatch",
     "CUTOUT_COLOR",
 ]
 
@@ -85,6 +90,20 @@ _OP_HIGH = np.array([t[2] for t in _OP_TABLE], np.float32)
 _OP_MIRROR = np.array([t[3] for t in _OP_TABLE], np.bool_)
 
 CUTOUT_COLOR = (125.0, 123.0, 114.0)  # reference augmentations.py:140
+
+# dispatch modes for batched policy application: "exact" is the i.i.d.
+# per-image sub-policy draw (vmapped lax.switch — XLA lowers the batched
+# op index to executing ALL 19 branches per image and selecting one);
+# "grouped" keeps the switch index SCALAR inside the compiled program
+# (stratified per-chunk sub-policy draws; one branch executes).
+AUG_DISPATCH_MODES = ("exact", "grouped")
+
+
+def check_aug_dispatch(mode: str) -> str:
+    if mode not in AUG_DISPATCH_MODES:
+        raise ValueError(
+            f"aug_dispatch must be one of {AUG_DISPATCH_MODES}, got {mode!r}")
+    return mode
 
 
 def op_index(name: str) -> int:
@@ -371,6 +390,22 @@ def apply_augment(img: jax.Array, name: str, level, key: jax.Array) -> jax.Array
     return apply_op(img, jnp.int32(op_index(name)), jnp.float32(level), key)
 
 
+@functools.lru_cache(maxsize=None)
+def _op_range_constants():
+    """Device-resident (low, high, mirror) op-range tables, built ONCE.
+
+    ``apply_op`` used to call ``jnp.asarray(_OP_LOW)`` (and friends) per
+    invocation, rebuilding the three constants on every trace.  Lazy
+    (not module-level) so importing this module never eagerly
+    initializes a JAX backend — bench tools probe backend liveness
+    before touching the device.  ``ensure_compile_time_eval`` keeps the
+    cached values CONCRETE even when the first call lands inside a
+    trace (a cached tracer would escape its trace scope)."""
+    with jax.ensure_compile_time_eval():
+        return (jnp.asarray(_OP_LOW), jnp.asarray(_OP_HIGH),
+                jnp.asarray(_OP_MIRROR))
+
+
 def apply_op(img: jax.Array, op_idx: jax.Array, level: jax.Array, key: jax.Array) -> jax.Array:
     """Apply op `op_idx` (traced scalar) at `level` in [0, 1].
 
@@ -379,10 +414,11 @@ def apply_op(img: jax.Array, op_idx: jax.Array, level: jax.Array, key: jax.Array
     ``lax.switch`` so the op id can be a runtime tensor (policy-as-data).
     """
     key_mirror, key_op = jax.random.split(key)
-    low = jnp.asarray(_OP_LOW)[op_idx]
-    high = jnp.asarray(_OP_HIGH)[op_idx]
+    op_low, op_high, op_mirror = _op_range_constants()
+    low = op_low[op_idx]
+    high = op_high[op_idx]
     value = level * (high - low) + low
-    mirrored = jnp.asarray(_OP_MIRROR)[op_idx]
+    mirrored = op_mirror[op_idx]
     sign = jnp.where(
         mirrored & (jax.random.uniform(key_mirror) > 0.5), -1.0, 1.0
     )
@@ -429,6 +465,108 @@ def apply_policy(img: jax.Array, policy: jax.Array, key: jax.Array) -> jax.Array
 
 
 def apply_policy_batch(images: jax.Array, policy: jax.Array, key: jax.Array) -> jax.Array:
-    """vmapped :func:`apply_policy` over a [B, H, W, C] batch."""
+    """vmapped :func:`apply_policy` over a [B, H, W, C] batch.
+
+    This is the EXACT dispatch path: every image draws its sub-policy
+    i.i.d., which makes the ``lax.switch`` op index a batched tensor —
+    XLA lowers that to executing all ``NUM_OPS`` branches for every
+    image per op slot and selecting one (~19x redundant op compute).
+    :func:`apply_policy_batch_grouped` is the scalar-dispatch
+    alternative."""
     keys = jax.random.split(key, images.shape[0])
     return jax.vmap(apply_policy, in_axes=(0, None, 0))(images, policy, keys)
+
+
+# ---------------------------------------------------------------------------
+# grouped scalar dispatch
+# ---------------------------------------------------------------------------
+
+
+def apply_policy_scalar_single(img: jax.Array, policy: jax.Array, key: jax.Array) -> jax.Array:
+    """:func:`apply_policy` specialized to a SINGLE-sub-policy tensor.
+
+    Consumes the key stream identically (the sub-policy-choice key is
+    split off and discarded — with one sub-policy the draw is
+    vacuous), but indexes ``policy[0]`` statically instead of through a
+    traced ``randint``: under an outer per-image vmap the op indices
+    stay UNBATCHED, so ``lax.switch`` keeps its scalar index and
+    executes exactly one branch.  Output is bitwise identical to
+    :func:`apply_policy` on the same ``[1, num_op, 3]`` policy."""
+    _key_choice, key_sub = jax.random.split(key)
+    return apply_subpolicy(img, policy[0], key_sub)
+
+
+def apply_subpolicy_batch(images: jax.Array, subpolicy: jax.Array, key: jax.Array) -> jax.Array:
+    """Apply ONE sub-policy to a whole [B, H, W, C] batch with scalar
+    op dispatch: `subpolicy` is unbatched under the image vmap, so each
+    ``lax.switch`` executes exactly one branch for the whole batch.
+    Per-image randomness (the `prob` gates, mirror signs, Cutout
+    centers) stays per-image through the vmapped keys."""
+    keys = jax.random.split(key, images.shape[0])
+    return jax.vmap(apply_subpolicy, in_axes=(0, None, 0))(images, subpolicy, keys)
+
+
+def grouped_permutation(key: jax.Array, batch: int):
+    """Shared helper: a PRNG-derived batch permutation and its inverse.
+
+    ``out[inv]`` undoes ``x[perm]`` — the grouped kernels shuffle with
+    `perm`, process contiguous chunks, and restore original order with
+    `inv`."""
+    perm = jax.random.permutation(key, batch)
+    inv = jnp.argsort(perm)
+    return perm, inv
+
+
+def apply_policy_batch_grouped(images: jax.Array, policy: jax.Array,
+                               key: jax.Array, *, groups: int) -> jax.Array:
+    """Grouped scalar-dispatch :func:`apply_policy_batch`.
+
+    Permutes the batch with a PRNG-derived permutation, splits it into
+    `groups` contiguous chunks, draws ONE sub-policy per chunk and
+    applies each chunk through :func:`apply_subpolicy` with a SCALAR op
+    index (the chunk loop is a ``lax.scan``, so the compiled program
+    contains one switch per op slot and each invocation executes
+    exactly one branch), then inverse-permutes.  Per-image `prob`
+    gating, mirror signs and op randomness remain exactly per-image.
+
+    Distributional deviation vs the exact path (documented in
+    docs/BENCHMARKS.md "Augmentation dispatch"): sub-policy selection
+    is STRATIFIED — each batch sees fixed per-chunk counts instead of
+    i.i.d. per-image draws.  The per-image marginal is unchanged (the
+    uniform permutation makes every image's chunk — hence its
+    sub-policy — uniform); only within-batch selection counts and the
+    joint (images of one chunk share a sub-policy) differ.
+
+    A single-sub-policy tensor short-circuits to the bitwise-exact
+    scalar path (:func:`apply_policy_scalar_single`): with one
+    sub-policy there is no selection to stratify, so grouped == exact
+    bit-for-bit — the case the TTA sub-policy audit runs per lane.
+    """
+    b = images.shape[0]
+    g = int(groups)
+    if g < 1:
+        raise ValueError(f"groups must be >= 1, got {groups}")
+    if int(policy.shape[0]) == 1:
+        keys = jax.random.split(key, b)
+        return jax.vmap(apply_policy_scalar_single, in_axes=(0, None, 0))(
+            images, policy, keys)
+    g = min(g, b)
+    key_perm, key_groups = jax.random.split(key)
+    perm, inv = grouped_permutation(key_perm, b)
+    shuffled = jnp.take(images, perm, axis=0)
+    chunk = -(-b // g)  # ceil: uneven batches pad up to g full chunks
+    pad = g * chunk - b
+    if pad:
+        shuffled = jnp.concatenate([shuffled, shuffled[:pad]], axis=0)
+    grouped = shuffled.reshape((g, chunk) + images.shape[1:])
+    group_keys = jax.random.split(key_groups, g)
+
+    def one_group(_, xs):
+        imgs, k = xs
+        key_choice, key_apply = jax.random.split(k)
+        idx = jax.random.randint(key_choice, (), 0, policy.shape[0])
+        return None, apply_subpolicy_batch(imgs, policy[idx], key_apply)
+
+    _, out = jax.lax.scan(one_group, None, (grouped, group_keys))
+    out = out.reshape((g * chunk,) + images.shape[1:])[:b]
+    return jnp.take(out, inv, axis=0)

@@ -22,7 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fast_autoaugment_tpu.ops.augment import apply_policy
+from fast_autoaugment_tpu.ops.augment import (
+    apply_policy,
+    apply_policy_batch_grouped,
+    apply_policy_scalar_single,
+    check_aug_dispatch,
+)
 
 __all__ = [
     "CIFAR_MEAN",
@@ -83,10 +88,16 @@ def cutout_default(img: jax.Array, key: jax.Array, length: int) -> jax.Array:
     return jnp.where(inside[..., None], 0.0, img)
 
 
-def _cifar_train_one(img, policy, key, cutout_length, mean, std):
+def _cifar_train_one(img, policy, key, cutout_length, mean, std,
+                     single_sub_scalar=False):
     k_policy, k_crop, k_flip, k_cutout = jax.random.split(key, 4)
     if policy is not None:
-        img = apply_policy(img, policy, k_policy)
+        if single_sub_scalar:
+            # bitwise-identical to apply_policy on a [1, num_op, 3]
+            # tensor, but the op index stays scalar under the batch vmap
+            img = apply_policy_scalar_single(img, policy, k_policy)
+        else:
+            img = apply_policy(img, policy, k_policy)
     img = random_crop_with_pad(img, k_crop, 4)
     img = random_hflip(img, k_flip)
     img = normalize(img, mean, std)
@@ -102,15 +113,32 @@ def cifar_train_batch(
     cutout_length: int = 16,
     mean: Sequence[float] = CIFAR_MEAN,
     std: Sequence[float] = CIFAR_STD,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
 ) -> jax.Array:
     """Full CIFAR/SVHN train-time stack on a [B, H, W, C] uint8-valued batch.
 
     `policy` is a [num_sub, num_op, 3] tensor (or None for 'default' aug).
-    """
+    ``aug_dispatch="exact"`` (default) is bit-for-bit the historical
+    per-image path; ``"grouped"`` applies the policy through
+    :func:`apply_policy_batch_grouped` (scalar op dispatch, stratified
+    per-chunk sub-policy draws, `aug_groups` chunks) before the
+    per-image crop/flip/normalize/cutout stack.  A single-sub-policy
+    tensor under "grouped" takes the bitwise-exact scalar path instead
+    (no selection to stratify)."""
+    check_aug_dispatch(aug_dispatch)
     images = images.astype(jnp.float32)
+    single_sub = policy is not None and int(policy.shape[0]) == 1
+    if aug_dispatch == "grouped" and policy is not None and not single_sub:
+        key, key_pol = jax.random.split(key)
+        images = apply_policy_batch_grouped(images, policy, key_pol,
+                                            groups=aug_groups)
+        policy = None
+    scalar = aug_dispatch == "grouped" and single_sub
     keys = jax.random.split(key, images.shape[0])
     return jax.vmap(
-        lambda im, k: _cifar_train_one(im, policy, k, cutout_length, mean, std)
+        lambda im, k: _cifar_train_one(im, policy, k, cutout_length, mean, std,
+                                       single_sub_scalar=scalar)
     )(images, keys)
 
 

@@ -32,7 +32,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fast_autoaugment_tpu.ops.augment import apply_policy, brightness as _brightness
+from fast_autoaugment_tpu.ops.augment import (
+    apply_policy,
+    apply_policy_batch_grouped,
+    apply_policy_scalar_single,
+    check_aug_dispatch,
+)
+from fast_autoaugment_tpu.ops.augment import brightness as _brightness
 from fast_autoaugment_tpu.ops.augment import color as _saturation
 from fast_autoaugment_tpu.ops.augment import contrast as _contrast
 from fast_autoaugment_tpu.ops.preprocess import IMAGENET_MEAN, IMAGENET_STD, normalize
@@ -159,12 +165,15 @@ def _lighting(img01, key, alphastd: float = 0.1):
     return img01 + rgb[None, None, :]
 
 
-def _train_one(img, policy, key, cutout_length):
+def _train_one(img, policy, key, cutout_length, single_sub_scalar=False):
     from fast_autoaugment_tpu.ops.preprocess import cutout_default
 
     k_pol, k_flip, k_jit, k_light, k_cut = jax.random.split(key, 5)
     if policy is not None:
-        img = apply_policy(img, policy, k_pol)
+        if single_sub_scalar:
+            img = apply_policy_scalar_single(img, policy, k_pol)
+        else:
+            img = apply_policy(img, policy, k_pol)
     img = jnp.where(jax.random.uniform(k_flip) < 0.5, img[:, ::-1], img)
     img = _color_jitter(img, k_jit)
     img01 = img / 255.0
@@ -181,11 +190,28 @@ def _train_one(img, policy, key, cutout_length):
 
 def imagenet_train_batch(images: jax.Array, key: jax.Array,
                          policy: jax.Array | None = None,
-                         cutout_length: int = 0) -> jax.Array:
-    """Device-side ImageNet train stack on host-cropped uint8 batches."""
+                         cutout_length: int = 0,
+                         aug_dispatch: str = "exact",
+                         aug_groups: int = 8) -> jax.Array:
+    """Device-side ImageNet train stack on host-cropped uint8 batches.
+
+    ``aug_dispatch``/``aug_groups`` mirror
+    :func:`fast_autoaugment_tpu.ops.preprocess.cifar_train_batch`:
+    "exact" (default) is the historical per-image path bit-for-bit,
+    "grouped" applies the policy with scalar op dispatch (stratified
+    per-chunk sub-policy draws) before the per-image jitter stack."""
+    check_aug_dispatch(aug_dispatch)
     images = images.astype(jnp.float32)
+    single_sub = policy is not None and int(policy.shape[0]) == 1
+    if aug_dispatch == "grouped" and policy is not None and not single_sub:
+        key, key_pol = jax.random.split(key)
+        images = apply_policy_batch_grouped(images, policy, key_pol,
+                                            groups=aug_groups)
+        policy = None
+    scalar = aug_dispatch == "grouped" and single_sub
     keys = jax.random.split(key, images.shape[0])
-    return jax.vmap(lambda im, k: _train_one(im, policy, k, cutout_length))(images, keys)
+    return jax.vmap(lambda im, k: _train_one(im, policy, k, cutout_length,
+                                             single_sub_scalar=scalar))(images, keys)
 
 
 def imagenet_eval_batch(images: jax.Array) -> jax.Array:

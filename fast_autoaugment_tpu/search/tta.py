@@ -55,17 +55,21 @@ def _jit_with_trace_counter(fn):
     return jitted
 
 
-def _default_augment_fn(cutout_length: int) -> Callable:
+def _default_augment_fn(cutout_length: int, aug_dispatch: str = "exact",
+                        aug_groups: int = 8) -> Callable:
     """CIFAR-family train stack (crop/flip/normalize + policy + cutout)."""
     def augment_fn(images, policy, key):
         return cifar_train_batch(images, key, policy=policy,
-                                 cutout_length=cutout_length)
+                                 cutout_length=cutout_length,
+                                 aug_dispatch=aug_dispatch,
+                                 aug_groups=aug_groups)
     return augment_fn
 
 
 def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
                   augment_fn: Callable | None = None,
-                  num_candidates: int | None = None):
+                  num_candidates: int | None = None,
+                  aug_dispatch: str = "exact", aug_groups: int = 8):
     """Build the jitted TTA evaluation step.
 
     With ``num_candidates=None`` (default) returns
@@ -87,17 +91,38 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
     one batch.  For either variant, one fixed argument shape = one
     executable for the whole search (the zero-recompile invariant;
     census via ``search.census.executable_census``).
-    """
-    if augment_fn is None:
-        augment_fn = _default_augment_fn(cutout_length)
 
-    def one_candidate(params, batch_stats, images, labels, mask, policy, key):
+    ``aug_dispatch="grouped"`` switches the augmentation to the
+    scalar-dispatch kernels (``ops/augment.py``): the P draw axis (and
+    for ``num_candidates=K`` the candidate axis) is traversed with
+    ``lax.map`` instead of ``vmap`` so the per-chunk sub-policy indices
+    stay SCALAR — a vmapped axis would re-batch them and XLA would fall
+    back to executing all 19 op branches.  The model forward still runs
+    on the full flattened batch either way.  A custom `augment_fn`
+    combined with grouped dispatch owns its own internal dispatch; this
+    function only serializes the outer axes for it.
+    """
+    from fast_autoaugment_tpu.ops.augment import check_aug_dispatch
+
+    check_aug_dispatch(aug_dispatch)
+    grouped = aug_dispatch == "grouped"
+    if augment_fn is None:
+        augment_fn = _default_augment_fn(cutout_length, aug_dispatch,
+                                         aug_groups)
+
+    def augment_draws(images, policy, key):
         keys = jax.random.split(key, num_policy)
 
         def one_draw(k):
             return augment_fn(images, policy, k)
 
-        augmented = jax.vmap(one_draw)(keys)  # [P, B, H, W, C]
+        if grouped:
+            # scan over draws: each draw's grouped dispatch keeps its
+            # scalar switch index (a draw vmap would batch it)
+            return jax.lax.map(one_draw, keys)  # [P, B, H, W, C]
+        return jax.vmap(one_draw)(keys)  # [P, B, H, W, C]
+
+    def score_augmented(params, batch_stats, augmented, labels, mask):
         p, b = augmented.shape[0], augmented.shape[1]
         flat = augmented.reshape((p * b,) + augmented.shape[2:])
         logits = model.apply(
@@ -127,11 +152,26 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
             "cnt": mask.sum().astype(jnp.float32),
         }
 
+    def one_candidate(params, batch_stats, images, labels, mask, policy, key):
+        augmented = augment_draws(images, policy, key)
+        return score_augmented(params, batch_stats, augmented, labels, mask)
+
     if num_candidates is None:
         return _jit_with_trace_counter(one_candidate)
 
     def tta_step_batched(params, batch_stats, images, labels, mask,
                          policies, keys):
+        if grouped:
+            # candidate axis: augment under lax.map (scalar dispatch
+            # preserved), then vmap only the forward/metrics over the
+            # pre-augmented [K, P, B, ...] tensor
+            augmented = jax.lax.map(
+                lambda pk: augment_draws(images, pk[0], pk[1]),
+                (policies, keys))
+            return jax.vmap(
+                lambda aug: score_augmented(params, batch_stats, aug,
+                                            labels, mask)
+            )(augmented)
         return jax.vmap(
             lambda pol, k: one_candidate(
                 params, batch_stats, images, labels, mask, pol, k)
@@ -141,7 +181,8 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
 
 
 def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
-                    augment_fn: Callable | None = None):
+                    augment_fn: Callable | None = None,
+                    aug_dispatch: str = "exact", aug_groups: int = 8):
     """Batched sub-policy audit step: evaluates S candidate sub-policies
     against one batch in ONE compiled call.
 
@@ -155,9 +196,22 @@ def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
     {"correct_mean_sum": [S], "cnt": scalar}``.  NOTE peak memory is S x
     the TTA step's (the [S, P, B, H, W, C] augmented tensor) — callers
     size S by image resolution (``audit_sub_policies``).
+
+    ``aug_dispatch="grouped"``: the S axis already fixes the sub-policy
+    per lane, so scalar dispatch needs NO distribution change — each
+    lane's ops are known per lane, and the grouped single-sub path is
+    bitwise identical to the exact one.  The S and draw axes are
+    traversed with ``lax.map`` (a vmap would re-batch the op indices
+    and lower back to all-branches execution); the forward stays one
+    flattened S*P*B batch.
     """
+    from fast_autoaugment_tpu.ops.augment import check_aug_dispatch
+
+    check_aug_dispatch(aug_dispatch)
+    grouped = aug_dispatch == "grouped"
     if augment_fn is None:
-        augment_fn = _default_augment_fn(cutout_length)
+        augment_fn = _default_augment_fn(cutout_length, aug_dispatch,
+                                         aug_groups)
 
     def audit_step(params, batch_stats, images, labels, mask, subs, key):
         s = subs.shape[0]
@@ -167,7 +221,13 @@ def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
             # a [1, num_op, 3] policy: every draw applies this sub-policy
             return jax.vmap(lambda k: augment_fn(images, sub[None], k))(ks)
 
-        augmented = jax.vmap(per_sub)(subs, keys)  # [S, P, B, H, W, C]
+        if grouped:
+            augmented = jax.lax.map(
+                lambda sk: jax.lax.map(
+                    lambda k: augment_fn(images, sk[0][None], k), sk[1]),
+                (subs, keys))  # [S, P, B, H, W, C]
+        else:
+            augmented = jax.vmap(per_sub)(subs, keys)  # [S, P, B, H, W, C]
         p, b = augmented.shape[1], augmented.shape[2]
         flat = augmented.reshape((s * p * b,) + augmented.shape[3:])
         logits = model.apply(

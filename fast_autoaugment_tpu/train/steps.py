@@ -35,6 +35,10 @@ from fast_autoaugment_tpu.core.metrics import (
     smooth_cross_entropy,
     top_k_correct,
 )
+from fast_autoaugment_tpu.ops.augment import (
+    apply_policy_batch_grouped,
+    check_aug_dispatch,
+)
 from fast_autoaugment_tpu.ops.optim import ema_update
 from fast_autoaugment_tpu.ops.preprocess import cifar_eval_batch, cifar_train_batch
 
@@ -47,6 +51,13 @@ __all__ = [
     "stack_states",
     "slice_state",
 ]
+
+
+# domain-separation tag for the stacked grouped-augmentation key
+# derivation: the fold's step key is fold_in(keys[k], step[k]) — folding
+# this tag on top keeps the grouped policy pass on a stream disjoint
+# from the in-body augment/model keys derived from the same pair
+_GROUPED_AUG_TAG = 7919
 
 
 class TrainState(struct.PyTreeNode):
@@ -91,6 +102,8 @@ def _make_train_step_body(
     cutout_length: int = 16,
     use_policy: bool = True,
     augment_fn: Callable | None = None,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
 ) -> Callable:
     """The UNJITTED per-model train-step body shared by the sequential
     and fold-stacked variants: :func:`make_train_step` jits it directly;
@@ -105,11 +118,13 @@ def _make_train_step_body(
     full run — the same deviation class as the repo's documented
     single-vs-multi-device drift (tests/test_train.py).
     """
+    check_aug_dispatch(aug_dispatch)
     if augment_fn is None:
         def augment_fn(images, policy, key):
             return cifar_train_batch(
                 images, key, policy=policy if use_policy else None,
                 cutout_length=cutout_length,
+                aug_dispatch=aug_dispatch, aug_groups=aug_groups,
             )
 
     def loss_fn(params, batch_stats, images, labels, key):
@@ -180,17 +195,25 @@ def make_train_step(
     cutout_length: int = 16,
     use_policy: bool = True,
     augment_fn: Callable | None = None,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
 ) -> Callable:
     """Build the jitted train step.
 
     Returns ``step_fn(state, images_u8, labels, policy, key) ->
     (state, metric_sums)``.  `augment_fn(images, policy, key)` defaults
     to the CIFAR/SVHN stack; pass an ImageNet stack for that family.
+    ``aug_dispatch``/``aug_groups`` select the policy-application
+    kernel of the DEFAULT augment_fn ("exact" = the historical
+    per-image vmapped-switch path bit-for-bit; "grouped" = scalar
+    dispatch with stratified per-chunk sub-policy draws); a custom
+    `augment_fn` owns its own dispatch.
     """
     body = _make_train_step_body(
         model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
         lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
         use_policy=use_policy, augment_fn=augment_fn,
+        aug_dispatch=aug_dispatch, aug_groups=aug_groups,
     )
     # donate the state: params/opt-state/EMA buffers are overwritten in
     # place, halving peak HBM for the update
@@ -208,6 +231,8 @@ def make_stacked_train_step(
     cutout_length: int = 16,
     use_policy: bool = True,
     augment_fn: Callable | None = None,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
 ) -> Callable:
     """Build the fold-stacked train step: K fold models advance in ONE
     jitted program per step (the Podracer whole-learner-replica vmap,
@@ -230,15 +255,56 @@ def make_stacked_train_step(
     epochs or run out of batches), but their state is passed through
     unchanged and their metric sums are zeroed, so a masked lane is
     indistinguishable from not having stepped at all.
+
+    ``aug_dispatch="grouped"`` needs special handling here: a grouped
+    kernel INSIDE the fold-vmapped body would see its per-fold scalar
+    sub-policy index re-batched by the fold axis, and ``lax.switch``
+    would fall straight back to executing all branches (the exact-mode
+    cost, with none of exact mode's distribution).  So the grouped
+    policy application is HOISTED out of the vmap: each fold's raw
+    batch goes through :func:`apply_policy_batch_grouped` in a static
+    per-fold loop (scalar dispatch preserved), keyed by
+    ``fold_in(fold_in(keys[k], states.step[k]), _GROUPED_AUG_TAG)`` so
+    per-fold streams stay independent and step-fresh, and the
+    fold-vmapped body then runs the policy-less per-image stack.
+    Exact mode is untouched — augmentation stays inside the body,
+    bit-for-bit the historical program.
     """
-    body = _make_train_step_body(
-        model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
-        lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
-        use_policy=use_policy, augment_fn=augment_fn,
-    )
+    check_aug_dispatch(aug_dispatch)
+    pre_policy = (aug_dispatch == "grouped" and augment_fn is None
+                  and use_policy)
+    if pre_policy:
+        def inner_augment(images, policy, key):
+            # the grouped policy pass already ran outside the vmap
+            return cifar_train_batch(images, key, policy=None,
+                                     cutout_length=cutout_length)
+
+        body = _make_train_step_body(
+            model, optimizer, num_classes=num_classes,
+            mixup_alpha=mixup_alpha, lb_smooth=lb_smooth, ema_mu=ema_mu,
+            cutout_length=cutout_length, use_policy=use_policy,
+            augment_fn=inner_augment,
+        )
+    else:
+        body = _make_train_step_body(
+            model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
+            lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
+            use_policy=use_policy, augment_fn=augment_fn,
+            aug_dispatch=aug_dispatch, aug_groups=aug_groups,
+        )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def stacked_fn(states, images, labels, policy, keys, active):
+        if pre_policy:
+            auged = []
+            for k in range(images.shape[0]):  # static fold count
+                key_pol = jax.random.fold_in(
+                    jax.random.fold_in(keys[k], states.step[k]),
+                    _GROUPED_AUG_TAG)
+                auged.append(apply_policy_batch_grouped(
+                    images[k].astype(jnp.float32), policy, key_pol,
+                    groups=aug_groups))
+            images = jnp.stack(auged)
         new_states, metrics = jax.vmap(
             body, in_axes=(0, 0, 0, None, 0)
         )(states, images, labels, policy, keys)

@@ -114,12 +114,17 @@ def train_and_eval(
     mesh=None,
     target_lb: int = -1,
     seed: int = 0,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
 ) -> dict:
     """Train (or just evaluate) one model under `conf`.
 
     Returns the reference-shaped result dict with per-split loss/top1/
     top5 plus 'epoch'.  `metric` in {'last', 'train', 'valid', 'test'}
     selects what "best" means (reference ``train.py:286-303``).
+    ``aug_dispatch``/``aug_groups`` pick the policy-application kernel
+    ("exact" default, bit-for-bit historical; "grouped" scalar
+    dispatch — see ``ops/augment.py``).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -193,7 +198,8 @@ def train_and_eval(
     if is_imagenet:
         cutout_len = int(conf.get("cutout", 0) or 0)
         augment_fn = lambda images, pol, key: imagenet_train_batch(  # noqa: E731
-            images, key, pol if use_policy else None, cutout_length=cutout_len
+            images, key, pol if use_policy else None, cutout_length=cutout_len,
+            aug_dispatch=aug_dispatch, aug_groups=aug_groups,
         )
         eval_preprocess = imagenet_eval_batch
     else:
@@ -209,6 +215,8 @@ def train_and_eval(
         cutout_length=int(conf.get("cutout", 0) or 0),
         use_policy=use_policy,
         augment_fn=augment_fn,
+        aug_dispatch=aug_dispatch,
+        aug_groups=aug_groups,
     )
     eval_step = make_eval_step(model, num_classes=num_classes,
                                lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
@@ -432,6 +440,8 @@ def train_folds_stacked(
     evaluation_interval: int = 5,
     mesh=None,
     resume: bool = True,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
 ) -> dict[int, dict]:
     """Train K phase-1 fold models as ONE vmapped program per step.
 
@@ -539,6 +549,8 @@ def train_folds_stacked(
         ema_mu=ema_mu,
         cutout_length=int(conf.get("cutout", 0) or 0),
         use_policy=use_policy,
+        aug_dispatch=aug_dispatch,
+        aug_groups=aug_groups,
     )
     eval_step = make_eval_step(
         model, num_classes=num_classes,
