@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 native bench bench-aug clean reproduce
+.PHONY: test test-t1 native bench bench-aug bench-dispatch clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
@@ -30,6 +30,13 @@ bench:
 # FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host, exit 3).
 bench-aug:
 	python tools/bench_aug.py
+
+# step-dispatch/device-cache bench: train_steps_per_sec at
+# --steps-per-dispatch N in {1,8,32} with the device cache vs the
+# host-fed N=1 loop, per-(N, cache) compile seconds in the JSON line.
+# Honors FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host).
+bench-dispatch:
+	python bench.py --dispatch-only
 
 clean:
 	$(MAKE) -C native clean

@@ -449,10 +449,206 @@ def bench_fold_stack(num_folds=5, steps=None) -> dict:
     return out
 
 
+def _dispatch_probe_model():
+    """Conv-free probe for `bench_step_dispatch`: dense + batch-norm.
+
+    The dispatch bench measures the per-step FIXED costs (host gather,
+    device_put, program launch, metric-sum dispatches) that multi-step
+    fusion removes, so the probe's device math must be small enough not
+    to drown them — AND must avoid convolutions, whose BACKWARD pass
+    inside an XLA:CPU while loop hits a ~3-4x slow kernel path that
+    would turn the CPU measurement into a conv-kernel artifact instead
+    of a dispatch measurement (`train/steps.py::default_dispatch_unroll`
+    documents the pathology; TPU scans of conv models are the standard
+    pjit-trainer shape and unaffected).  Set FAA_BENCH_SD_MODEL to a
+    registry model (e.g. wresnet10_1) to measure a CNN probe instead —
+    on CPU that number understates the win for exactly this reason.
+    """
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class DispatchProbe(nn.Module):
+        features: int = 32
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(self.features)(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             momentum=0.9)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    return DispatchProbe()
+
+
+def bench_step_dispatch(ns=(1, 8, 32), steps=None) -> dict:
+    """Train-step dispatch throughput: `train_steps_per_sec` at
+    ``--steps-per-dispatch N`` with the device cache vs the host feed.
+
+    Runs a faithful miniature of the trainer's inner loop — the real
+    jitted step (`make_train_step`) fed fresh host batches through
+    `train_batches` + `shard_batch`, with the trainer's per-step
+    metric-sum accumulation (one fancy-gather + H2D copy + dispatch +
+    metric adds per step, today's path) — against the real multi-step
+    program (`make_multistep_train_step` over a `DeviceCache`: one
+    int32 index matrix + ONE dispatch + one metric add per N steps).
+    The probe model is deliberately dispatch-bound and conv-free
+    (see `_dispatch_probe_model`; FAA_BENCH_SD_MODEL overrides), at
+    `FAA_BENCH_SD_IMG` px / batch `FAA_BENCH_SD_BATCH`.  On a TPU the
+    same amortization applies on top of device math the MXU finishes
+    faster — the CPU number measures the scheduling win, not chip
+    throughput, exactly as `bench_fold_stack` does for fold stacking.
+    Per-(N, cache) compile seconds ride in the JSON line.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fast_autoaugment_tpu.core.metrics import Accumulator
+    from fast_autoaugment_tpu.data.datasets import ArrayDataset
+    from fast_autoaugment_tpu.data.pipeline import (
+        DeviceCache,
+        train_batches,
+        train_index_matrix,
+    )
+    from fast_autoaugment_tpu.models import get_model
+    from fast_autoaugment_tpu.ops.optim import build_optimizer
+    from fast_autoaugment_tpu.parallel.mesh import (
+        make_mesh,
+        place_index_matrix,
+        replicated,
+        shard_batch,
+    )
+    from fast_autoaugment_tpu.train.steps import (
+        create_train_state,
+        make_multistep_train_step,
+        make_train_step,
+        make_train_step_body,
+    )
+
+    model_type = os.environ.get("FAA_BENCH_SD_MODEL", "linear")
+    img = int(os.environ.get("FAA_BENCH_SD_IMG", 8))
+    batch = int(os.environ.get("FAA_BENCH_SD_BATCH", 4))
+    if steps is None:
+        # divisible by every N so all configs run the same step count
+        steps = max(max(ns), int(os.environ.get("FAA_BENCH_SD_STEPS", 192)))
+        steps -= steps % max(ns)
+    repeats = max(1, int(os.environ.get("FAA_BENCH_SD_REPEATS", 3)))
+
+    mesh = make_mesh()
+    model = (_dispatch_probe_model() if model_type == "linear"
+             else get_model({"type": model_type}, 10))
+    # conv-free probe: the rolled scan is the fast CPU shape (and the
+    # TPU production shape); registry CNN probes take the trainer's
+    # default_dispatch_unroll (full unroll on CPU — conv-backward-in-
+    # loop pathology, _dispatch_probe_model docstring)
+    unroll = 1 if model_type == "linear" else None
+    opt_conf = {"type": "sgd", "decay": 2e-4, "clip": 5.0, "momentum": 0.9,
+                "nesterov": True}
+    kw = dict(num_classes=10, cutout_length=0, use_policy=False)
+    sample = jnp.zeros((2, img, img, 3), jnp.float32)
+    rng = np.random.default_rng(0)
+    n_examples = max(256, 2 * batch)
+    ds = ArrayDataset(
+        rng.integers(0, 256, (n_examples, img, img, 3), dtype=np.uint8),
+        rng.integers(0, 10, (n_examples,), np.int32), 10)
+    rep = replicated(mesh)
+    pol = jax.device_put(jnp.zeros((1, 1, 3), jnp.float32), rep)
+    key = jax.device_put(jax.random.PRNGKey(0), rep)
+
+    def fresh_state():
+        opt = build_optimizer(opt_conf, lambda s: 0.05)
+        state = create_train_state(model, opt, jax.random.PRNGKey(0), sample,
+                                   use_ema=False)
+        # mesh-commit: uncommitted state + committed cache knocks every
+        # dispatch off the C++ fast path (make_multistep_train_step)
+        return jax.device_put(state, rep)
+
+    out = {"probe": {"model": model_type, "image": img, "batch": batch,
+                     "steps": steps, "scan_unroll": unroll or "default"},
+           "train_steps_per_sec": {}, "compile_sec": {}}
+
+    def host_epoch(state, step_fn, n_steps):
+        acc = Accumulator()
+        done = 0
+        while done < n_steps:  # cycle fresh epochs until n_steps consumed
+            for b in train_batches(ds, None, batch, epoch=done):
+                b = shard_batch(mesh, {"x": b[0], "y": b[1]})
+                state, metrics = step_fn(state, b["x"], b["y"], pol, key)
+                acc.add_dict(metrics)
+                done += 1
+                if done >= n_steps:
+                    break
+        return state
+
+    # host-fed N=1: today's loop — gather + device_put + dispatch per step
+    opt = build_optimizer(opt_conf, lambda s: 0.05)
+    seq_step = make_train_step(model, opt, **kw)
+    t_c = time.perf_counter()
+    state = host_epoch(fresh_state(), seq_step, 1)  # compile + warm
+    jax.block_until_ready(state.params)
+    out["compile_sec"]["hostfeed_n1"] = round(time.perf_counter() - t_c, 2)
+    rate = 0.0
+    for _ in range(repeats):
+        state = fresh_state()
+        t0 = time.perf_counter()
+        state = host_epoch(state, seq_step, steps)
+        jax.block_until_ready(state.params)
+        rate = max(rate, steps / (time.perf_counter() - t0))
+    out["train_steps_per_sec"]["hostfeed_n1"] = round(rate, 2)
+    _log(f"step dispatch host-fed N=1: {rate:.1f} steps/s best-of-{repeats}")
+
+    # device cache at each N: one dispatch per N steps, index-fed
+    opt = build_optimizer(opt_conf, lambda s: 0.05)
+    body = make_train_step_body(model, opt, **kw)
+    cache = DeviceCache(ds, mesh)
+    for n in ns:
+        multi = make_multistep_train_step(body, steps_per_dispatch=n,
+                                          unroll=unroll)
+
+        def cache_epoch(state, n_steps, n=n, multi=multi):
+            acc = Accumulator()
+            done = 0
+            while done < n_steps:
+                mat = train_index_matrix(np.arange(n_examples), batch,
+                                         epoch=done)
+                for lo in range(0, len(mat) - len(mat) % n, n):
+                    idx = place_index_matrix(mesh, mat[lo:lo + n])
+                    state, metrics = multi(state, cache.images, cache.labels,
+                                           idx, pol, key)
+                    acc.add_dict(metrics)
+                    done += n
+                    if done >= n_steps:
+                        break
+            return state
+
+        t_c = time.perf_counter()
+        state = cache_epoch(fresh_state(), n)  # compile + warm
+        jax.block_until_ready(state.params)
+        out["compile_sec"][f"cache_n{n}"] = round(time.perf_counter() - t_c, 2)
+        rate = 0.0
+        for _ in range(repeats):
+            state = fresh_state()
+            t0 = time.perf_counter()
+            state = cache_epoch(state, steps)
+            jax.block_until_ready(state.params)
+            rate = max(rate, steps / (time.perf_counter() - t0))
+        out["train_steps_per_sec"][f"cache_n{n}"] = round(rate, 2)
+        _log(f"step dispatch cache N={n}: {rate:.1f} steps/s "
+             f"best-of-{repeats}")
+
+    base = out["train_steps_per_sec"].get("hostfeed_n1")
+    top = out["train_steps_per_sec"].get(f"cache_n{max(ns)}")
+    if base and top:
+        out["speedup_cache_max_n_vs_hostfeed"] = round(top / base, 2)
+    return out
+
+
 def main():
     # stamp BEFORE any compile ramps our own load into the 1-min average
     contention = refuse_or_flag_contention(host_contention_stamp())
     _ensure_live_backend(
+        reexec_argv=[sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
         # plumbing heartbeat only — keep the CPU run small
         fallback_env={
             "FAA_BENCH_BATCH": "32",
@@ -460,6 +656,23 @@ def main():
             "FAA_BENCH_WARMUP": "1",
         },
     )
+    if "--dispatch-only" in sys.argv:
+        # `make bench-dispatch`: just the step-dispatch/device-cache
+        # sweep, one JSON line (same stamp discipline as the headline)
+        sd = bench_step_dispatch()
+        print(json.dumps({
+            "metric": "train_steps_per_sec",
+            "train_steps_per_sec": sd["train_steps_per_sec"],
+            "compile_sec": sd["compile_sec"],
+            "probe": sd["probe"],
+            "speedup_cache_max_n_vs_hostfeed": sd.get(
+                "speedup_cache_max_n_vs_hostfeed"),
+            "backend": ("cpu-fallback"
+                        if os.environ.get("FAA_BENCH_CPU_FALLBACK")
+                        else __import__("jax").devices()[0].platform),
+            "contention": contention,
+        }))
+        return
     import jax
     import jax.numpy as jnp
 
@@ -520,6 +733,18 @@ def main():
     dt = time.perf_counter() - t0
     images_per_sec_per_chip = MEASURE_STEPS * global_batch / dt / n_dev
 
+    # per-step spread (BENCH_r05 reported a 3-step mean with no
+    # sample-size signal): a second pass timing each step individually
+    # (block per step — slightly pessimistic vs the pipelined headline,
+    # but the variance is the point, not the mean)
+    step_times = []
+    for _ in range(MEASURE_STEPS):
+        t_s = time.perf_counter()
+        state, metrics = step_exec(state, batch["x"], batch["y"], policy, rng)
+        jax.block_until_ready(state.params)
+        step_times.append(time.perf_counter() - t_s)
+    step_time_stddev = float(np.std(step_times, ddof=1)) if len(step_times) > 1 else 0.0
+
     # MFU: per-device FLOPs of the whole fused step (aug+fwd/bwd+opt)
     # x step rate / chip peak (VERDICT round 1, weak 2)
     flops = _step_flops(step_exec)
@@ -577,6 +802,11 @@ def main():
         # dropped from the JSON line — the multi-minute first TPU compile
         # is a real cost the artifact should carry
         "compile_train_step_sec": round(compile_train_step_sec, 1),
+        # sample-size + spread provenance (BENCH_r05 carried a 3-step
+        # mean with neither): how many steps the mean covers and how
+        # noisy the individually-timed steps were
+        "steps_measured": MEASURE_STEPS,
+        "step_time_stddev_sec": round(step_time_stddev, 6),
         "batch_per_device": BATCH_PER_DEVICE,
         "devices": n_dev,
         "contention": contention,
@@ -606,6 +836,20 @@ def main():
         except Exception as e:  # noqa: BLE001 — never sink the headline
             _log(f"fold-stack bench failed: {e}")
             out["fold_stack_steps_per_sec"] = None
+
+    # step-dispatch throughput: train steps/sec at --steps-per-dispatch
+    # N with/without the device cache (FAA_BENCH_STEP_DISPATCH=0 skips)
+    # — tracks the host-loop-removal win the way fold_stack_steps_per_
+    # sec tracks fold stacking
+    if os.environ.get("FAA_BENCH_STEP_DISPATCH", "1") != "0":
+        try:
+            sd = bench_step_dispatch()
+            out["train_steps_per_sec"] = sd["train_steps_per_sec"]
+            out["step_dispatch_bench"] = {k: v for k, v in sd.items()
+                                          if k != "train_steps_per_sec"}
+        except Exception as e:  # noqa: BLE001 — never sink the headline
+            _log(f"step dispatch bench failed: {e}")
+            out["train_steps_per_sec"] = None
     latest_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "docs", "bench_tpu_latest.json")
     if os.environ.get("FAA_BENCH_CPU_FALLBACK"):

@@ -27,8 +27,10 @@ import numpy as np
 
 from fast_autoaugment_tpu.data.datasets import ArrayDataset
 
-__all__ = ["BatchIterator", "train_batches", "stacked_train_batches",
-           "eval_batches", "prefetch"]
+__all__ = ["BatchIterator", "DeviceCache", "train_batches",
+           "stacked_train_batches", "eval_batches", "prefetch",
+           "train_index_matrix", "stacked_index_matrix",
+           "resolve_device_cache", "split_dispatch_chunks"]
 
 
 def _decode(paths: np.ndarray, transform=None, size: int | None = None) -> np.ndarray:
@@ -106,6 +108,34 @@ def _decode_boxed(paths, imgsize: int, box_fn, rng, size_cache: SizeCache) -> np
     return np.stack(out)
 
 
+def train_index_matrix(
+    indices: np.ndarray,
+    global_batch: int,
+    epoch: int,
+    *,
+    seed: int = 0,
+    process_index: int = 0,
+    process_count: int = 1,
+    rng: np.random.Generator | None = None,
+) -> np.ndarray:
+    """The epoch's batch composition as an int64 ``[steps, shard]``
+    matrix: the SAME ``default_rng((seed, epoch))`` permutation,
+    drop-last step count and per-process contiguous shard that
+    :func:`train_batches` walks — it IS what train_batches walks (the
+    iterator consumes this matrix), so the device-cache path's in-program
+    gathers are identical-by-construction to the host path's fancy
+    indexing.  `rng` lets a caller that needs the post-permutation
+    stream (lazy decode) hand in the generator to consume from.
+    """
+    if rng is None:
+        rng = np.random.default_rng((seed, epoch))
+    idx = rng.permutation(np.asarray(indices))
+    steps = len(idx) // global_batch
+    shard = global_batch // process_count
+    mat = idx[:steps * global_batch].reshape(steps, global_batch)
+    return mat[:, process_index * shard:(process_index + 1) * shard]
+
+
 def train_batches(
     dataset: ArrayDataset,
     indices: np.ndarray | None,
@@ -132,15 +162,14 @@ def train_batches(
     """
     idx = np.arange(len(dataset)) if indices is None else np.asarray(indices)
     rng = np.random.default_rng((seed, epoch))
-    idx = rng.permutation(idx)
-    steps = len(idx) // global_batch
-    shard = global_batch // process_count
+    mat = train_index_matrix(
+        idx, global_batch, epoch, seed=seed, process_index=process_index,
+        process_count=process_count, rng=rng,
+    )
     transform = None
     if host_transform is not None:
         transform = lambda img: host_transform(img, rng)  # noqa: E731
-    for s in range(steps):
-        chunk = idx[s * global_batch:(s + 1) * global_batch]
-        chunk = chunk[process_index * shard:(process_index + 1) * shard]
+    for chunk in mat:
         images = dataset.images[chunk]
         if dataset.lazy:
             if box_fn is not None:
@@ -149,6 +178,49 @@ def train_batches(
             else:
                 images = _decode(images, transform, decode_size)
         yield images, dataset.labels[chunk]
+
+
+def stacked_index_matrix(
+    fold_indices: list,
+    global_batch: int,
+    epoch: int,
+    *,
+    seeds: list,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The multiplexed per-fold batch composition for one epoch:
+    ``(chunks [steps, K, shard] int64, active [steps, K] float32)``.
+
+    Fold k's row stream is exactly :func:`train_index_matrix` for
+    ``(fold_indices[k], seeds[k])``; exhausted lanes (shorter folds)
+    carry wrapped filler indices with ``active=0`` so the stacked shape
+    never changes.  :func:`stacked_train_batches` consumes this matrix,
+    and the fold-stacked device-cache path ships it instead of images —
+    one source of truth for batch composition on both feed paths.
+    """
+    num_folds = len(fold_indices)
+    if len(seeds) != num_folds:
+        raise ValueError(f"{len(seeds)} seeds for {num_folds} folds")
+    perms, steps = [], []
+    for k in range(num_folds):
+        idx = np.asarray(fold_indices[k])
+        perms.append(np.random.default_rng((seeds[k], epoch)).permutation(idx))
+        steps.append(len(idx) // global_batch)
+    shard = global_batch // process_count
+    total = max(steps, default=0)
+    all_chunks = np.empty((total, num_folds, shard), np.int64)
+    all_active = np.empty((total, num_folds), np.float32)
+    for s in range(total):
+        for k in range(num_folds):
+            if s < steps[k]:
+                chunk = perms[k][s * global_batch:(s + 1) * global_batch]
+            else:  # exhausted lane: wrapped filler, masked out by `active`
+                chunk = np.resize(perms[k], global_batch)
+            all_chunks[s, k] = chunk[process_index * shard:
+                                     (process_index + 1) * shard]
+            all_active[s, k] = 1.0 if s < steps[k] else 0.0
+    return all_chunks, all_active
 
 
 def stacked_train_batches(
@@ -198,25 +270,14 @@ def stacked_train_batches(
     if len(seeds) != num_folds:
         raise ValueError(f"{len(seeds)} seeds for {num_folds} folds")
     rng = np.random.default_rng((int(seeds[0]), epoch, 971))  # lazy decode only
-    perms, steps = [], []
-    for k in range(num_folds):
-        idx = np.asarray(fold_indices[k])
-        perms.append(np.random.default_rng((seeds[k], epoch)).permutation(idx))
-        steps.append(len(idx) // global_batch)
-    shard = global_batch // process_count
+    all_chunks, all_active = stacked_index_matrix(
+        fold_indices, global_batch, epoch, seeds=seeds,
+        process_index=process_index, process_count=process_count,
+    )
     transform = None
     if host_transform is not None:
         transform = lambda img: host_transform(img, rng)  # noqa: E731
-    for s in range(max(steps, default=0)):
-        active = np.asarray([s < n for n in steps], np.float32)
-        chunks = []
-        for k in range(num_folds):
-            if s < steps[k]:
-                chunk = perms[k][s * global_batch:(s + 1) * global_batch]
-            else:  # exhausted lane: wrapped filler, masked out by `active`
-                chunk = np.resize(perms[k], global_batch)
-            chunks.append(chunk[process_index * shard:(process_index + 1) * shard])
-        chunks = np.stack(chunks)  # [K, S]
+    for chunks, active in zip(all_chunks, all_active):
         if dataset.lazy:
             flat_paths = dataset.images[chunks.reshape(-1)]
             uniq, inverse = np.unique(flat_paths, return_inverse=True)
@@ -229,6 +290,90 @@ def stacked_train_batches(
         else:
             images = dataset.images[chunks]
         yield images, dataset.labels[chunks], active
+
+
+class DeviceCache:
+    """Device-resident dataset: the whole uint8 NHWC image array plus
+    labels uploaded ONCE, example axis sharded over the mesh's data axis
+    (``parallel.mesh.place_dataset``).
+
+    The training inner loop then never ships images: the per-epoch
+    shuffled order is still computed on host with the identical
+    ``default_rng((seed, epoch))`` permutation (:func:`train_index_matrix`
+    / :func:`stacked_index_matrix` — the same matrices the host iterators
+    walk), but only the int32 index matrix crosses to the device, and the
+    compiled train program gathers each batch from the resident copy
+    (``train.steps.make_multistep_train_step``).  This is the training-
+    side twin of the search path's upload-once/replay-batches discipline
+    (``search/tta.py::eval_tta``).
+
+    Eager (in-memory) datasets only: a lazy dataset has nothing resident
+    to gather from — ``resolve_device_cache`` gates it off.  HBM cost is
+    the raw uint8 array (CIFAR-10 train: 50000*32*32*3 = 146 MiB; see
+    docs/BENCHMARKS.md "Step dispatch & device cache" for the budget
+    math), divided across the data-axis shards.
+    """
+
+    def __init__(self, dataset: ArrayDataset, mesh, axis_name: str = "data"):
+        if dataset.lazy:
+            raise ValueError(
+                "DeviceCache needs an in-memory dataset; lazy (on-disk) "
+                "datasets keep the host prefetch path")
+        from fast_autoaugment_tpu.parallel.mesh import place_dataset
+
+        images = np.ascontiguousarray(dataset.images)
+        labels = np.ascontiguousarray(dataset.labels)
+        self.num_examples = len(dataset)
+        self.nbytes = int(images.nbytes + labels.nbytes)
+        self.images, self.labels = place_dataset(
+            mesh, images, labels, axis_name)
+        self.mesh = mesh
+
+
+def resolve_device_cache(mode, dataset: ArrayDataset, *,
+                         process_count: int = 1) -> bool:
+    """Resolve the ``--device-cache {auto,on,off}`` knob to a bool.
+
+    ``"auto"`` (default) enables the device-resident path exactly when
+    it is a pure win with unchanged semantics: an eager (in-memory)
+    dataset on a single-process runtime.  Lazy datasets force it off
+    (nothing resident to gather from — the prefetch/decode path stays),
+    as does multi-host (per-process index shards feed
+    ``make_array_from_process_local_data``-placed batches today; the
+    cache path does not reimplement that assembly).  ``"on"`` is an
+    explicit ask and RAISES where auto would silently fall back, so a
+    launch script cannot believe it cached what it streamed.
+    """
+    if mode in (False, None, 0, "off", "0"):
+        return False
+    if mode in (True, 1, "on"):
+        if dataset.lazy:
+            raise ValueError(
+                "--device-cache on: dataset is lazy (on-disk) — the "
+                "device cache only serves in-memory datasets; use "
+                "--device-cache auto/off")
+        if process_count > 1:
+            raise ValueError(
+                "--device-cache on: multi-host runs keep the per-process "
+                "host feed; use --device-cache auto/off")
+        return True
+    if mode == "auto":
+        return not dataset.lazy and process_count == 1
+    raise ValueError(f"unknown device-cache mode {mode!r}: use auto/on/off")
+
+
+def split_dispatch_chunks(total_steps: int, steps_per_dispatch: int) -> list[int]:
+    """Split an epoch's step count into per-dispatch chunk sizes.
+
+    Full chunks of ``steps_per_dispatch`` plus one clamped remainder
+    chunk, so checkpoint cadence, per-epoch eval and resume always land
+    on dispatch boundaries and an epoch compiles at most two program
+    shapes (N and ``total % N``), each reused every epoch."""
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}")
+    full, rem = divmod(total_steps, steps_per_dispatch)
+    return [steps_per_dispatch] * full + ([rem] if rem else [])
 
 
 def eval_batches(

@@ -125,6 +125,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 (default) = the sequential per-fold loop "
                         "bit-for-bit; 'auto' stacks every fold needing "
                         "training; K caps the stack width")
+    p.add_argument("--device-cache", default="auto",
+                   choices=("auto", "on", "off"),
+                   help="device-resident data path for phase-1 fold "
+                        "pretraining, gate retrains and phase-3 retrains: "
+                        "upload the eager dataset once, gather batches by "
+                        "index inside the compiled step.  'auto' "
+                        "(default) = on for in-memory single-host "
+                        "datasets, bit-for-bit at --steps-per-dispatch 1; "
+                        "lazy ImageNet datasets keep the prefetch path "
+                        "(docs/BENCHMARKS.md 'Step dispatch & device "
+                        "cache')")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="fuse N train steps into ONE dispatch (lax.scan "
+                        "over the device cache; composes with "
+                        "--fold-stack: one dispatch then advances "
+                        "K folds x N steps).  1 (default) = historical "
+                        "per-step dispatch bit-for-bit; N>1 deviates by "
+                        "the documented ~1 f32 ULP/step scan bound")
     p.add_argument("--num-result-per-cv", type=int, default=5,
                    help="phase-3 retrains per mode (reference search.py:270)")
     p.add_argument("--until", type=int, default=3,
@@ -191,6 +209,8 @@ def main(argv=None):
         fold_stack=args.fold_stack,
         aug_dispatch=args.aug_dispatch,
         aug_groups=args.aug_groups,
+        device_cache=args.device_cache,
+        steps_per_dispatch=args.steps_per_dispatch,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []
@@ -281,6 +301,8 @@ def main(argv=None):
                 mode_conf, args.dataroot, test_ratio=0.0,
                 save_path=path, metric="last", seed=seeds[run],
                 aug_dispatch=args.aug_dispatch, aug_groups=args.aug_groups,
+                device_cache=args.device_cache,
+                steps_per_dispatch=args.steps_per_dispatch,
             )
             outcomes[mode].append(float(res.get("top1_test", 0.0)))
             logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,

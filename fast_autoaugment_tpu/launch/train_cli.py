@@ -43,6 +43,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "draws — docs/BENCHMARKS.md 'Augmentation dispatch')")
     p.add_argument("--aug-groups", type=int, default=8,
                    help="chunks per batch for --aug-dispatch grouped")
+    p.add_argument("--device-cache", default="auto",
+                   choices=("auto", "on", "off"),
+                   help="device-resident data path: upload the eager "
+                        "dataset to HBM once (sharded over the mesh data "
+                        "axis) and gather batches by index INSIDE the "
+                        "compiled step — no per-step host image copy.  "
+                        "'auto' (default) enables it for in-memory "
+                        "datasets on a single host (bit-for-bit with the "
+                        "host feed at --steps-per-dispatch 1); lazy "
+                        "ImageNet datasets keep the prefetch path; 'on' "
+                        "errors where auto would fall back "
+                        "(docs/BENCHMARKS.md 'Step dispatch & device "
+                        "cache')")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="fuse N train steps into ONE dispatch (lax.scan "
+                        "over the device cache; needs --device-cache "
+                        "auto/on).  1 (default) = the historical "
+                        "one-dispatch-per-step loop bit-for-bit; N>1 "
+                        "deviates by the documented ~1 f32 ULP/step scan "
+                        "bound and amortizes per-dispatch host overhead")
     p.add_argument("--coordinator", default=None, help="host0 addr for multi-host")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
@@ -77,6 +97,8 @@ def main(argv=None):
         seed=args.seed,
         aug_dispatch=args.aug_dispatch,
         aug_groups=args.aug_groups,
+        device_cache=args.device_cache,
+        steps_per_dispatch=args.steps_per_dispatch,
     )
     elapsed = time.time() - t0
     logger.info("done %s: %s", args.tag, json.dumps(

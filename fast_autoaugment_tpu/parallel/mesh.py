@@ -40,6 +40,9 @@ __all__ = [
     "shard_stacked_batch",
     "shard_transform",
     "stacked_shard_transform",
+    "place_dataset",
+    "place_index_matrix",
+    "place_stacked_index_matrix",
     "distributed_init",
     "local_batch_to_global",
 ]
@@ -162,6 +165,59 @@ def shard_transform(mesh: Mesh, keys=("x", "y"), axis_name: str = "data"):
         return shard_batch(mesh, dict(zip(keys, item, strict=True)), axis_name)
 
     return transform
+
+
+def place_dataset(mesh: Mesh, images: np.ndarray, labels: np.ndarray,
+                  axis_name: str = "data"):
+    """Upload a whole eager dataset ONCE, example axis sharded over the
+    mesh's data axis — the storage placement behind
+    ``data.pipeline.DeviceCache``.
+
+    The example count is padded up to a multiple of the data-axis shard
+    count with zero rows so every device holds an equal slab; pad rows
+    are never referenced (the index matrices only name real examples).
+    Train steps then gather their batches from this resident copy by
+    index INSIDE the compiled program — no per-step H2D image copy.
+    Returns ``(images_dev, labels_dev)``.
+    """
+    shards = mesh.shape[axis_name]
+    n = images.shape[0]
+    if labels.shape[0] != n:
+        raise ValueError(f"{n} images but {labels.shape[0]} labels")
+    pad = (-n) % shards
+    if pad:
+        images = np.concatenate(
+            [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+        labels = np.concatenate(
+            [labels, np.zeros((pad,) + labels.shape[1:], labels.dtype)])
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.device_put(images, sharding), jax.device_put(labels, sharding)
+
+
+def place_index_matrix(mesh: Mesh, idx: np.ndarray, axis_name: str = "data"):
+    """Place a ``[N, B]`` per-dispatch batch-index matrix: the scan
+    (step) axis replicated, the batch axis sharded over the data axis —
+    the only per-step H2D traffic the device-cache path ships (int32,
+    ~KBs instead of the uint8 image batch)."""
+    spec = P(*([None] * (idx.ndim - 1) + [axis_name]))
+    return jax.device_put(np.ascontiguousarray(idx, np.int32),
+                          NamedSharding(mesh, spec))
+
+
+def place_stacked_index_matrix(mesh: Mesh, idx: np.ndarray,
+                               active: np.ndarray,
+                               fold_axis: str = "fold",
+                               data_axis: str = "data"):
+    """Stacked counterpart of :func:`place_index_matrix` for a
+    :func:`make_fold_mesh` mesh: ``idx [N, K, B]`` rides (scan, fold,
+    data), ``active [N, K]`` rides (scan, fold)."""
+    idx_dev = jax.device_put(
+        np.ascontiguousarray(idx, np.int32),
+        NamedSharding(mesh, P(None, fold_axis, data_axis)))
+    act_dev = jax.device_put(
+        np.ascontiguousarray(active, np.float32),
+        NamedSharding(mesh, P(None, fold_axis)))
+    return idx_dev, act_dev
 
 
 def local_batch_to_global(batch_per_device: int, mesh: Mesh) -> int:

@@ -454,6 +454,8 @@ def search_policies(
     fold_stack: int | str = 0,
     aug_dispatch: str = "exact",
     aug_groups: int = 8,
+    device_cache: str = "auto",
+    steps_per_dispatch: int = 1,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -508,6 +510,18 @@ def search_policies(
     in-memory datasets: a `train_fold_fn` override, lazy (ImageNet)
     datasets, and every quality-gate retrain take the sequential path
     unchanged.
+
+    `device_cache` ("auto"/"on"/"off") and `steps_per_dispatch` (N)
+    select the device-resident data path for every phase-1 training run
+    (sequential folds, the fold stack, and quality-gate retrains): the
+    dataset is uploaded once, per-epoch index matrices replace the image
+    feed, and one dispatch advances N steps (x K folds when stacked) —
+    ``train.trainer.train_and_eval`` docstring.  Defaults ("auto", 1)
+    are bit-for-bit with the host-fed path on eager datasets; lazy
+    (ImageNet) datasets keep the prefetch path under "auto".  Both are
+    stamped into ``search_result.json``.  Phase-2 TTA already replays
+    device-resident fold batches (``_FoldEval``), so the knob does not
+    touch it.
 
     `aug_dispatch` ("exact" default / "grouped") selects the policy
     application kernel for phase-2 TTA evaluation and the sub-policy
@@ -584,6 +598,13 @@ def search_policies(
     # kernel scored these trials (grouped deviates distributionally)
     result["aug_dispatch"] = evaluator.aug_dispatch
     result["aug_groups"] = evaluator.aug_groups
+    # feed-path stamping: which data path trained the phase-1 oracles
+    # (steps_per_dispatch>1 deviates by the documented scan ULP bound)
+    steps_per_dispatch = max(1, int(steps_per_dispatch))
+    result["device_cache"] = device_cache
+    result["steps_per_dispatch"] = steps_per_dispatch
+    train_feed_kw = dict(device_cache=device_cache,
+                         steps_per_dispatch=steps_per_dispatch)
     fold_baselines: dict[int, float] = {}
     excluded_folds: list[int] = []
 
@@ -635,7 +656,7 @@ def search_policies(
             train_folds_stacked(
                 no_aug_conf, dataroot, cv_ratio=cv_ratio, folds=group,
                 save_paths=[fold_paths[f] for f in group], seed=seed,
-                resume=resume,
+                resume=resume, **train_feed_kw,
             )
             g_secs = (time.time() - t_g) * mesh.size
             for f in group:
@@ -683,6 +704,7 @@ def search_policies(
                     no_aug_conf, dataroot,
                     test_ratio=cv_ratio, cv_fold=fold,
                     save_path=path, metric="last", seed=seed,
+                    **train_feed_kw,
                 )
             phase1_attr[fold] += (time.time() - t_f) * mesh.size
         else:
@@ -718,6 +740,7 @@ def search_policies(
                 train_and_eval(
                     no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
                     save_path=alt, metric="last", seed=retry_seed,
+                    **train_feed_kw,
                 )
             phase1_attr[fold] += (time.time() - t_r) * mesh.size
             alt_acc = evaluator.baseline(fold, alt)

@@ -46,8 +46,13 @@ __all__ = [
     "TrainState",
     "create_train_state",
     "make_train_step",
+    "make_train_step_body",
     "make_stacked_train_step",
+    "make_stacked_step_body",
+    "make_multistep_train_step",
+    "default_dispatch_unroll",
     "make_eval_step",
+    "make_replay_eval_step",
     "stack_states",
     "slice_state",
 ]
@@ -184,6 +189,11 @@ def _make_train_step_body(
     return step_fn
 
 
+# public name: the device-cache multi-step dispatcher wraps this body in
+# a lax.scan (make_multistep_train_step), and benches/tests build it too
+make_train_step_body = _make_train_step_body
+
+
 def make_train_step(
     model,
     optimizer,
@@ -218,6 +228,75 @@ def make_train_step(
     # donate the state: params/opt-state/EMA buffers are overwritten in
     # place, halving peak HBM for the update
     return functools.partial(jax.jit, donate_argnums=(0,))(body)
+
+
+def make_stacked_step_body(
+    model,
+    optimizer,
+    *,
+    num_classes: int,
+    mixup_alpha: float = 0.0,
+    lb_smooth: float = 0.0,
+    ema_mu: float = 0.0,
+    cutout_length: int = 16,
+    use_policy: bool = True,
+    augment_fn: Callable | None = None,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
+) -> Callable:
+    """The UNJITTED fold-stacked step (fold vmap + grouped-dispatch
+    hoist + active-lane masking): :func:`make_stacked_train_step` jits
+    it directly; :func:`make_multistep_train_step` wraps it in a
+    ``lax.scan`` over N steps (the scan sits OUTSIDE the fold vmap, so
+    the grouped policy pass stays hoisted with a scalar switch index).
+    See :func:`make_stacked_train_step` for the full contract."""
+    check_aug_dispatch(aug_dispatch)
+    pre_policy = (aug_dispatch == "grouped" and augment_fn is None
+                  and use_policy)
+    if pre_policy:
+        def inner_augment(images, policy, key):
+            # the grouped policy pass already ran outside the vmap
+            return cifar_train_batch(images, key, policy=None,
+                                     cutout_length=cutout_length)
+
+        body = _make_train_step_body(
+            model, optimizer, num_classes=num_classes,
+            mixup_alpha=mixup_alpha, lb_smooth=lb_smooth, ema_mu=ema_mu,
+            cutout_length=cutout_length, use_policy=use_policy,
+            augment_fn=inner_augment,
+        )
+    else:
+        body = _make_train_step_body(
+            model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
+            lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
+            use_policy=use_policy, augment_fn=augment_fn,
+            aug_dispatch=aug_dispatch, aug_groups=aug_groups,
+        )
+
+    def stacked_fn(states, images, labels, policy, keys, active):
+        if pre_policy:
+            auged = []
+            for k in range(images.shape[0]):  # static fold count
+                key_pol = jax.random.fold_in(
+                    jax.random.fold_in(keys[k], states.step[k]),
+                    _GROUPED_AUG_TAG)
+                auged.append(apply_policy_batch_grouped(
+                    images[k].astype(jnp.float32), policy, key_pol,
+                    groups=aug_groups))
+            images = jnp.stack(auged)
+        new_states, metrics = jax.vmap(
+            body, in_axes=(0, 0, 0, None, 0)
+        )(states, images, labels, policy, keys)
+
+        def select(new, old):
+            gate = active.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(gate > 0, new, old)
+
+        new_states = jax.tree.map(select, new_states, states)
+        metrics = {k: v * active for k, v in metrics.items()}
+        return new_states, metrics
+
+    return stacked_fn
 
 
 def make_stacked_train_step(
@@ -270,54 +349,117 @@ def make_stacked_train_step(
     Exact mode is untouched — augmentation stays inside the body,
     bit-for-bit the historical program.
     """
-    check_aug_dispatch(aug_dispatch)
-    pre_policy = (aug_dispatch == "grouped" and augment_fn is None
-                  and use_policy)
-    if pre_policy:
-        def inner_augment(images, policy, key):
-            # the grouped policy pass already ran outside the vmap
-            return cifar_train_batch(images, key, policy=None,
-                                     cutout_length=cutout_length)
+    body = make_stacked_step_body(
+        model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
+        lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
+        use_policy=use_policy, augment_fn=augment_fn,
+        aug_dispatch=aug_dispatch, aug_groups=aug_groups,
+    )
+    return functools.partial(jax.jit, donate_argnums=(0,))(body)
 
-        body = _make_train_step_body(
-            model, optimizer, num_classes=num_classes,
-            mixup_alpha=mixup_alpha, lb_smooth=lb_smooth, ema_mu=ema_mu,
-            cutout_length=cutout_length, use_policy=use_policy,
-            augment_fn=inner_augment,
-        )
+
+def default_dispatch_unroll(steps_per_dispatch: int) -> int:
+    """Measured-default ``unroll`` for :func:`make_multistep_train_step`.
+
+    On XLA:CPU, convolution BACKWARD passes inside a ``while`` loop hit
+    a slow kernel path (~3-4x the out-of-loop cost per step, measured
+    on wresnet10_1; dense-only bodies are unaffected) — any loop at all
+    triggers it, so partial unroll buys nothing and the only fast CPU
+    shape is the fully unrolled one (compile time then grows ~linearly
+    with N; acceptable at the small N the CPU dev/test path uses).  On
+    TPU the rolled scan is the standard pjit-trainer shape and keeps
+    compile time independent of N, which is what production wants at
+    N=32 on minutes-long WRN compiles.  See docs/BENCHMARKS.md "Step
+    dispatch & device cache".
+    """
+    return steps_per_dispatch if jax.default_backend() == "cpu" else 1
+
+
+def make_multistep_train_step(
+    body: Callable,
+    *,
+    steps_per_dispatch: int,
+    stacked: bool = False,
+    unroll: int | None = None,
+) -> Callable:
+    """Fuse N train steps into ONE jitted dispatch over a device-resident
+    dataset cache (`data.pipeline.DeviceCache`): a ``lax.scan`` over the
+    step axis whose body gathers each batch from the cache BY INDEX
+    inside the program — the sequence-of-steps-in-one-program structure
+    of the Podracer architectures (arXiv:2104.06272) and the pjit-era
+    LLM trainers.  The host loop's per-step work collapses from
+    (fancy-gather + H2D image copy + dispatch) x N to shipping one int32
+    index matrix and dispatching once.
+
+    `body` is an UNJITTED step body:
+
+    - sequential (``stacked=False``): :func:`make_train_step_body`'s
+      ``(state, images, labels, policy, key) -> (state, metrics)``.
+      Returns ``fn(state, cache_images, cache_labels, idx [N, B],
+      policy, key) -> (state, metric_sums)``.
+    - stacked (``stacked=True``): :func:`make_stacked_step_body`'s
+      ``(states, images, labels, policy, keys, active)``.  Returns
+      ``fn(states, cache_images, cache_labels, idx [N, K, B], policy,
+      keys, active [N, K]) -> (states, metric_sums [K])``.  The scan
+      sits OUTSIDE the fold vmap, so the PR-3 grouped-dispatch hoist
+      inside the body keeps its scalar switch index.
+
+    Per-step PRNG derivation is untouched: the body folds the carried
+    ``state.step`` into the base key, so step t inside the scan draws
+    exactly the keys the host loop's t-th dispatch would.  Metrics come
+    back summed over the N steps (they are count-weighted sums already);
+    with ``steps_per_dispatch=1`` the scan is skipped entirely and the
+    program is the single-step body behind a gather — the configuration
+    pinned bit-for-bit against the host path (tests/test_device_cache.py).
+
+    The state is donated (same discipline as :func:`make_train_step`);
+    the cache arrays are NOT — they persist across dispatches by design.
+    Callers must COMMIT the carried state (and the small replicated
+    inputs) to the mesh (``jax.device_put(state, replicated(mesh))``)
+    before the first call: compiling with an uncommitted state against
+    the mesh-committed cache arrays pushes every later call off the C++
+    fast dispatch path onto a per-leaf reshard (measured ~17x per-call
+    overhead on the 84-leaf WRN state) — the trainer does this, as the
+    stacked trainer always has.  ``unroll`` feeds ``lax.scan``
+    (default :func:`default_dispatch_unroll`: full unroll on the CPU
+    backend, whose conv-backward-in-loop slow path otherwise eats the
+    win; rolled on accelerators).
+    """
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}")
+    if unroll is None:
+        unroll = default_dispatch_unroll(steps_per_dispatch)
+
+    def gather(cache_images, cache_labels, idx_n):
+        return (jnp.take(cache_images, idx_n, axis=0),
+                jnp.take(cache_labels, idx_n, axis=0))
+
+    if not stacked:
+        def multi_fn(state, cache_images, cache_labels, idx, policy, key):
+            def one(carry, idx_n):
+                images, labels = gather(cache_images, cache_labels, idx_n)
+                return body(carry, images, labels, policy, key)
+
+            if steps_per_dispatch == 1:
+                return one(state, idx[0])
+            state, metrics = jax.lax.scan(one, state, idx, unroll=unroll)
+            return state, jax.tree.map(lambda v: v.sum(axis=0), metrics)
     else:
-        body = _make_train_step_body(
-            model, optimizer, num_classes=num_classes, mixup_alpha=mixup_alpha,
-            lb_smooth=lb_smooth, ema_mu=ema_mu, cutout_length=cutout_length,
-            use_policy=use_policy, augment_fn=augment_fn,
-            aug_dispatch=aug_dispatch, aug_groups=aug_groups,
-        )
+        def multi_fn(states, cache_images, cache_labels, idx, policy, keys,
+                     active):
+            def one(carry, step_in):
+                idx_n, active_n = step_in
+                images, labels = gather(cache_images, cache_labels, idx_n)
+                return body(carry, images, labels, policy, keys, active_n)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def stacked_fn(states, images, labels, policy, keys, active):
-        if pre_policy:
-            auged = []
-            for k in range(images.shape[0]):  # static fold count
-                key_pol = jax.random.fold_in(
-                    jax.random.fold_in(keys[k], states.step[k]),
-                    _GROUPED_AUG_TAG)
-                auged.append(apply_policy_batch_grouped(
-                    images[k].astype(jnp.float32), policy, key_pol,
-                    groups=aug_groups))
-            images = jnp.stack(auged)
-        new_states, metrics = jax.vmap(
-            body, in_axes=(0, 0, 0, None, 0)
-        )(states, images, labels, policy, keys)
+            if steps_per_dispatch == 1:
+                return one(states, (idx[0], active[0]))
+            states, metrics = jax.lax.scan(one, states, (idx, active),
+                                           unroll=unroll)
+            return states, jax.tree.map(lambda v: v.sum(axis=0), metrics)
 
-        def select(new, old):
-            gate = active.reshape((-1,) + (1,) * (new.ndim - 1))
-            return jnp.where(gate > 0, new, old)
-
-        new_states = jax.tree.map(select, new_states, states)
-        metrics = {k: v * active for k, v in metrics.items()}
-        return new_states, metrics
-
-    return stacked_fn
+    return functools.partial(jax.jit, donate_argnums=(0,))(multi_fn)
 
 
 def stack_states(states: list[TrainState]) -> TrainState:
@@ -333,14 +475,13 @@ def slice_state(states: TrainState, fold_axis_index: int) -> TrainState:
     return jax.tree.map(lambda x: x[fold_axis_index], states)
 
 
-def make_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
-                   preprocess_fn: Callable | None = None) -> Callable:
-    """Build the jitted eval step: ``fn(params, batch_stats, images_u8,
-    labels) -> metric_sums`` (loss/top1/top5/num as sums)."""
+def _make_eval_body(model, *, num_classes: int, lb_smooth: float = 0.0,
+                    preprocess_fn: Callable | None = None) -> Callable:
+    """The unjitted eval body shared by the per-batch and the fused
+    replay eval steps."""
     if preprocess_fn is None:
         preprocess_fn = cifar_eval_batch
 
-    @jax.jit
     def eval_fn(params, batch_stats, images, labels, mask):
         """`mask` [B] of 0/1 marks real examples — eval batches are padded
         up to a multiple of the mesh size and the padding masked out, so
@@ -361,3 +502,48 @@ def make_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
         }
 
     return eval_fn
+
+
+def make_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
+                   preprocess_fn: Callable | None = None) -> Callable:
+    """Build the jitted eval step: ``fn(params, batch_stats, images_u8,
+    labels, mask) -> metric_sums`` (loss/top1/top5/num as sums)."""
+    return jax.jit(_make_eval_body(
+        model, num_classes=num_classes, lb_smooth=lb_smooth,
+        preprocess_fn=preprocess_fn))
+
+
+def make_replay_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
+                          preprocess_fn: Callable | None = None) -> Callable:
+    """Whole-split evaluation in ONE dispatch: ``fn(params, batch_stats,
+    images [S, B, H, W, C], labels [S, B], masks [S, B]) -> metric_sums``
+    — a ``lax.scan`` of the eval body over a device-resident stack of
+    batches with the metric sums reduced in-program.
+
+    This is the eval twin of :func:`make_multistep_train_step` for the
+    device-cache replay path, and it is a CORRECTNESS fix as well as a
+    perf one: evaluating a replayed split per batch queues S eval
+    programs plus 4S scalar-add programs, and with a mesh-committed
+    state every one of those scalar adds lowers to an all-participant
+    collective — on the 8-virtual-device CPU test mesh, hundreds of
+    queued tiny collectives interleave their rendezvous and DEADLOCK
+    the backend (observed: eval wedged in `Accumulator.add` with XLA
+    "waiting for all participants" stalls).  One fused program per
+    split sequences its internal collectives correctly and leaves the
+    host with a single 4-scalar read.  Forward-only, so the XLA:CPU
+    conv-backward-in-while pathology (`default_dispatch_unroll`) does
+    not apply — the rolled scan is fast on every backend.
+    """
+    body = _make_eval_body(model, num_classes=num_classes,
+                           lb_smooth=lb_smooth, preprocess_fn=preprocess_fn)
+
+    @jax.jit
+    def replay_fn(params, batch_stats, images, labels, masks):
+        def one(carry, batch):
+            x, y, m = batch
+            return carry, body(params, batch_stats, x, y, m)
+
+        _, sums = jax.lax.scan(one, jnp.zeros(()), (images, labels, masks))
+        return jax.tree.map(lambda v: v.sum(axis=0), sums)
+
+    return replay_fn

@@ -36,8 +36,13 @@ from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.data.pipeline import (
     BatchIterator,
+    DeviceCache,
     prefetch,
+    resolve_device_cache,
+    split_dispatch_chunks,
+    stacked_index_matrix,
     stacked_train_batches,
+    train_index_matrix,
 )
 from fast_autoaugment_tpu.models import get_model, num_class
 from fast_autoaugment_tpu.ops.optim import build_optimizer
@@ -45,6 +50,9 @@ from fast_autoaugment_tpu.ops.schedules import build_schedule
 from fast_autoaugment_tpu.parallel.mesh import (
     make_fold_mesh,
     make_mesh,
+    place_index_matrix,
+    place_stacked_index_matrix,
+    replicated,
     shard_transform,
     stacked_shard_transform,
 )
@@ -52,8 +60,12 @@ from fast_autoaugment_tpu.policies.archive import load_policy, policy_to_tensor
 from fast_autoaugment_tpu.train.steps import (
     create_train_state,
     make_eval_step,
+    make_multistep_train_step,
+    make_replay_eval_step,
+    make_stacked_step_body,
     make_stacked_train_step,
     make_train_step,
+    make_train_step_body,
     slice_state,
     stack_states,
 )
@@ -92,12 +104,66 @@ def _run_eval(eval_step, params, batch_stats, batches, mesh) -> dict:
     padding/sharding lives in `eval_batches` (one place, multi-host
     aware), not here.  Host slicing/decoding and the H2D copy run in
     the prefetch worker so they overlap the previous batch's device
-    eval."""
+    eval.  The device-cache path evaluates differently: splits are
+    placed once and replayed in one fused dispatch per shape group
+    (:func:`_stacked_eval_splits` + :func:`_run_replay_eval` — the
+    ``search/tta.py::eval_tta`` upload-once discipline applied to
+    training eval)."""
     acc = Accumulator()
     sharded = prefetch(batches, transform=shard_transform(mesh, ("x", "y", "m")))
     for batch in sharded:
         acc.add_dict(eval_step(params, batch_stats, batch["x"], batch["y"], batch["m"]))
     return acc.normalize()
+
+
+def _stacked_eval_splits(it: BatchIterator, global_batch: int, mesh,
+                         eval_kw: dict) -> list:
+    """Materialize one eval epoch as device-resident SHAPE-GROUPED batch
+    stacks (``{"x": [S, B, ...], "y": [S, B], "m": [S, B]}``) for
+    one-dispatch replay through ``make_replay_eval_step`` (usually one
+    group; a padded final partial batch of a different size forms a
+    second).  Placed once per split, reused every evaluation epoch."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    groups: dict = {}
+    for x, y, m in it.eval_epoch(global_batch, **eval_kw):
+        groups.setdefault(x.shape, []).append((x, y, m))
+    sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
+    out = []
+    for items in groups.values():
+        out.append({
+            "x": jax.device_put(np.stack([x for x, _, _ in items]), sharding),
+            "y": jax.device_put(np.stack([y for _, y, _ in items]), sharding),
+            "m": jax.device_put(np.stack([m for _, _, m in items]), sharding),
+        })
+    return out
+
+
+def _run_replay_eval(replay_step, params, batch_stats, groups) -> dict:
+    """One fused dispatch per shape group over a replayed split."""
+    acc = Accumulator()
+    for g in groups:
+        acc.add_dict(replay_step(params, batch_stats, g["x"], g["y"], g["m"]))
+    return acc.normalize()
+
+
+def _sum_metric_dicts(metric_dicts: list) -> dict:
+    """Epoch-end host-side accumulation of per-dispatch metric sums.
+
+    Sequential float32 adds over the synced values — the SAME chain the
+    host path's on-device `Accumulator` adds compute, so the reported
+    sums stay bit-identical.  Summing on host AFTER the epoch (the sums
+    are read at epoch end regardless) instead of queueing one scalar-add
+    program per metric per dispatch matters on the virtual CPU mesh:
+    with a mesh-committed state those adds are all-participant
+    collectives, and long unsynced chains of them deadlock the backend
+    (``make_replay_eval_step`` docstring)."""
+    sums: dict = {}
+    for m in metric_dicts:
+        for k, v in m.items():
+            v32 = np.asarray(v, np.float32)
+            sums[k] = v32 if k not in sums else np.float32(sums[k] + v32)
+    return sums
 
 
 def train_and_eval(
@@ -116,6 +182,8 @@ def train_and_eval(
     seed: int = 0,
     aug_dispatch: str = "exact",
     aug_groups: int = 8,
+    device_cache: str = "auto",
+    steps_per_dispatch: int = 1,
 ) -> dict:
     """Train (or just evaluate) one model under `conf`.
 
@@ -125,6 +193,21 @@ def train_and_eval(
     ``aug_dispatch``/``aug_groups`` pick the policy-application kernel
     ("exact" default, bit-for-bit historical; "grouped" scalar
     dispatch — see ``ops/augment.py``).
+
+    ``device_cache`` ("auto"/"on"/"off") selects the device-resident
+    data path: the whole eager dataset is uploaded ONCE (sharded over
+    the mesh data axis), each epoch ships only the int32 index matrix of
+    the IDENTICAL host-side shuffle, and the compiled program gathers
+    its batches in place (``data.pipeline.DeviceCache``); eval splits
+    are likewise placed once and replayed every evaluation epoch.
+    "auto" enables it exactly for eager single-process datasets — lazy
+    (ImageNet) datasets keep the prefetch/decode path.
+    ``steps_per_dispatch`` (N, needs the cache) fuses N train steps into
+    one ``lax.scan`` dispatch (``make_multistep_train_step``): N=1
+    (default) is bit-for-bit the host-fed path; N>1 deviates by the
+    documented ~1 f32 ULP/step scan-kernel bound (the fold-stacking
+    deviation class — docs/BENCHMARKS.md "Step dispatch & device
+    cache").
     """
     if mesh is None:
         mesh = make_mesh()
@@ -168,6 +251,16 @@ def train_and_eval(
     valid_it = BatchIterator(total_train, valid_idx, **it_kw)
     test_it = BatchIterator(testset, **it_kw)
 
+    use_cache = resolve_device_cache(device_cache, total_train,
+                                     process_count=jax.process_count())
+    steps_per_dispatch = int(steps_per_dispatch)
+    if steps_per_dispatch > 1 and not use_cache:
+        raise ValueError(
+            f"steps_per_dispatch={steps_per_dispatch} needs the device "
+            "cache (in-program batch gather); it is "
+            f"{'off' if device_cache == 'off' else 'unavailable (lazy dataset or multi-host)'} "
+            "here — use --device-cache auto/on with an eager dataset")
+
     batch_per_device = int(conf["batch"])
     global_batch = batch_per_device * mesh.size
     if not only_eval and len(train_idx) < global_batch:
@@ -205,9 +298,7 @@ def train_and_eval(
     else:
         augment_fn = None
         eval_preprocess = None
-    train_step = make_train_step(
-        model,
-        optimizer,
+    step_kw = dict(
         num_classes=num_classes,
         mixup_alpha=float(conf.get("mixup", 0.0) or 0.0),
         lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
@@ -218,9 +309,27 @@ def train_and_eval(
         aug_dispatch=aug_dispatch,
         aug_groups=aug_groups,
     )
+    if use_cache:
+        # device-resident path: the body is dispatched through the
+        # multi-step gather program; at most two chunk shapes per epoch
+        # (N and the clamped remainder), each compiled once and reused
+        step_body = make_train_step_body(model, optimizer, **step_kw)
+        multi_fns: dict[int, Callable] = {}
+
+        def get_multi_step(n: int) -> Callable:
+            if n not in multi_fns:
+                multi_fns[n] = make_multistep_train_step(
+                    step_body, steps_per_dispatch=n)
+            return multi_fns[n]
+    else:
+        train_step = make_train_step(model, optimizer, **step_kw)
     eval_step = make_eval_step(model, num_classes=num_classes,
                                lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
                                preprocess_fn=eval_preprocess)
+    replay_eval = make_replay_eval_step(
+        model, num_classes=num_classes,
+        lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
+        preprocess_fn=eval_preprocess) if use_cache else None
 
     writers = make_writers(
         os.path.dirname(save_path) if save_path else None,
@@ -256,6 +365,10 @@ def train_and_eval(
 
     result: dict = {"epoch": epoch_start - 1}
     best_metric = -1e9
+    # device-cache eval replay: each split is placed once on first
+    # evaluation and reused for every later one (and for the EMA pass,
+    # which previously re-fed the split within the SAME evaluation)
+    eval_replay: dict[str, list] = {}
 
     def evaluate(tag_prefix: str, epoch: int) -> dict:
         # empty splits are SKIPPED, not reported as zeros: with
@@ -273,16 +386,30 @@ def train_and_eval(
                 process_count=jax.process_count(),
                 pad_multiple=mesh.size,
             )
-            norm = _run_eval(
-                eval_step, state.params, state.batch_stats,
-                it.eval_epoch(global_batch, **eval_kw), mesh,
-            )
-            out[split] = norm
-            if state.ema is not None:
-                norm_ema = _run_eval(
-                    eval_step, state.ema["params"], state.ema["batch_stats"],
+            if use_cache:
+                if split not in eval_replay:
+                    eval_replay[split] = _stacked_eval_splits(
+                        it, global_batch, mesh, eval_kw)
+                norm = _run_replay_eval(
+                    replay_eval, state.params, state.batch_stats,
+                    eval_replay[split])
+            else:
+                norm = _run_eval(
+                    eval_step, state.params, state.batch_stats,
                     it.eval_epoch(global_batch, **eval_kw), mesh,
                 )
+            out[split] = norm
+            if state.ema is not None:
+                if use_cache:
+                    norm_ema = _run_replay_eval(
+                        replay_eval, state.ema["params"],
+                        state.ema["batch_stats"], eval_replay[split])
+                else:
+                    norm_ema = _run_eval(
+                        eval_step, state.ema["params"],
+                        state.ema["batch_stats"],
+                        it.eval_epoch(global_batch, **eval_kw), mesh,
+                    )
                 # with EMA on, the REPORTED valid/test numbers are the
                 # EMA model's (reference train.py:277-280 overwrites
                 # rs['valid']/rs['test']); raw weights kept under _raw
@@ -312,37 +439,85 @@ def train_and_eval(
     if metric == "test" and len(test_it) == 0:
         raise ValueError("metric='test' with an empty test split")
 
+    train_cache = DeviceCache(total_train, mesh) if use_cache else None
+    if train_cache is not None:
+        logger.info(
+            "device cache: %d examples (%.1f MiB uint8) resident, "
+            "steps_per_dispatch=%d", train_cache.num_examples,
+            train_cache.nbytes / 2**20, steps_per_dispatch)
+        # commit the carried state + replicated inputs to the mesh
+        # BEFORE the first dispatch: an uncommitted state compiled
+        # against the mesh-committed cache knocks every later call off
+        # the C++ fast dispatch path (make_multistep_train_step note)
+        state = jax.device_put(state, replicated(mesh))
+        rng = jax.device_put(rng, replicated(mesh))
+
     t_start = time.time()
+    pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
+    if train_cache is not None:
+        pol = jax.device_put(pol, replicated(mesh))
     for epoch in range(epoch_start, epochs + 1):
         acc = Accumulator()
-        batches = prefetch(
-            train_it.train_epoch(
-                global_batch, epoch, seed=seed,
-                process_index=jax.process_index(),
-                process_count=jax.process_count(),
-            ),
-            transform=shard_transform(mesh),
-        )
-        pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
         # live per-batch progress (the reference's tqdm postfix,
         # train.py:79-88): FAA_PROGRESS=N prints a loss-EMA line every N
-        # batches.  Off by default — reading metrics per batch forces a
-        # device sync and stalls the dispatch pipeline, which is why the
-        # epoch loop otherwise never touches metric values mid-epoch.
+        # batches (dispatches on the cache path).  Off by default —
+        # reading metrics per batch forces a device sync and stalls the
+        # dispatch pipeline, which is why the epoch loop otherwise never
+        # touches metric values mid-epoch.
         try:
             progress_every = int(os.environ.get("FAA_PROGRESS", "0") or 0)
         except ValueError:  # cosmetic knob must never kill a run
             progress_every = 0
         loss_ema = None
-        for bi, batch in enumerate(batches):
-            state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
-            acc.add_dict(metrics)
+
+        def progress(bi: int, metrics, epoch=epoch):
+            nonlocal loss_ema
             if is_master and progress_every and (bi + 1) % progress_every == 0:
                 cur = float(metrics["loss"]) / max(float(metrics["num"]), 1.0)
                 loss_ema = cur if loss_ema is None else 0.9 * loss_ema + 0.1 * cur
                 sys.stderr.write(
                     f"\r[epoch {epoch} batch {bi + 1}] loss_ema={loss_ema:.4f} ")
                 sys.stderr.flush()
+
+        if train_cache is not None:
+            # device-resident feed: the per-epoch shuffle is the
+            # IDENTICAL host permutation; only the index matrix is
+            # shipped, and each dispatch advances a whole scan chunk
+            mat = train_index_matrix(
+                train_idx, global_batch, epoch, seed=seed,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+            pos = 0
+            dispatch_metrics: list = []
+            for di, n in enumerate(split_dispatch_chunks(
+                    len(mat), steps_per_dispatch)):
+                idx_dev = place_index_matrix(mesh, mat[pos:pos + n])
+                state, metrics = get_multi_step(n)(
+                    state, train_cache.images, train_cache.labels,
+                    idx_dev, pol, rng)
+                # per-dispatch sums are kept as ASYNC device handles and
+                # summed on host at epoch end (_sum_metric_dicts): with
+                # the committed state a per-dispatch jnp add would queue
+                # one tiny all-participant collective per metric, and
+                # long unsynced chains of those wedge the CPU backend
+                dispatch_metrics.append(metrics)
+                progress(di, metrics)
+                pos += n
+            acc.add_dict(_sum_metric_dicts(dispatch_metrics))
+        else:
+            batches = prefetch(
+                train_it.train_epoch(
+                    global_batch, epoch, seed=seed,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count(),
+                ),
+                transform=shard_transform(mesh),
+            )
+            for bi, batch in enumerate(batches):
+                state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
+                acc.add_dict(metrics)
+                progress(bi, metrics)
         if is_master and progress_every and loss_ema is not None:
             sys.stderr.write("\n")
         train_metrics = acc.normalize()
@@ -442,6 +617,8 @@ def train_folds_stacked(
     resume: bool = True,
     aug_dispatch: str = "exact",
     aug_groups: int = 8,
+    device_cache: str = "auto",
+    steps_per_dispatch: int = 1,
 ) -> dict[int, dict]:
     """Train K phase-1 fold models as ONE vmapped program per step.
 
@@ -478,6 +655,13 @@ def train_folds_stacked(
     sequential path in the search driver (per-fold host decode streams
     cannot be multiplexed bit-for-bit; ``stacked_train_batches``
     docstring).
+
+    ``device_cache``/``steps_per_dispatch`` compose with the stack: the
+    shared dataset is uploaded once, the multiplexed ``[steps, K, B]``
+    index matrix replaces the image feed, and one ``lax.scan`` dispatch
+    advances K folds x N steps (the scan sits outside the fold vmap —
+    ``make_multistep_train_step``).  The dataset here is always eager
+    (checked above), so "auto" enables the cache on single-process runs.
     """
     if len(folds) != len(save_paths):
         raise ValueError(f"{len(folds)} folds but {len(save_paths)} paths")
@@ -540,9 +724,14 @@ def train_folds_stacked(
     use_policy = policy is not None
     pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
 
-    stacked_step = make_stacked_train_step(
-        model,
-        optimizer,
+    use_cache = resolve_device_cache(device_cache, total_train,
+                                     process_count=jax.process_count())
+    steps_per_dispatch = int(steps_per_dispatch)
+    if steps_per_dispatch > 1 and not use_cache:
+        raise ValueError(
+            f"steps_per_dispatch={steps_per_dispatch} needs the device "
+            "cache (in-program batch gather) — use --device-cache auto/on")
+    step_kw = dict(
         num_classes=num_classes,
         mixup_alpha=float(conf.get("mixup", 0.0) or 0.0),
         lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
@@ -552,10 +741,25 @@ def train_folds_stacked(
         aug_dispatch=aug_dispatch,
         aug_groups=aug_groups,
     )
+    if use_cache:
+        stacked_body = make_stacked_step_body(model, optimizer, **step_kw)
+        multi_fns: dict[int, Callable] = {}
+
+        def get_multi_step(n: int) -> Callable:
+            if n not in multi_fns:
+                multi_fns[n] = make_multistep_train_step(
+                    stacked_body, steps_per_dispatch=n, stacked=True)
+            return multi_fns[n]
+    else:
+        stacked_step = make_stacked_train_step(model, optimizer, **step_kw)
     eval_step = make_eval_step(
         model, num_classes=num_classes,
         lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
     )
+    replay_eval = make_replay_eval_step(
+        model, num_classes=num_classes,
+        lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
+    ) if use_cache else None
 
     # per-fold init/restore, then one stacked state
     states, epoch_starts = [], []
@@ -596,6 +800,10 @@ def train_folds_stacked(
         fold: {"epoch": epoch_starts[k] - 1} for k, fold in enumerate(folds)
     }
 
+    # device-cache eval replay: valid splits are per fold, the test
+    # split is shared — each placed once, reused every eval epoch
+    eval_replay: dict = {}
+
     def evaluate_fold(k: int, state_k) -> dict:
         out = {}
         eval_kw = dict(
@@ -606,12 +814,32 @@ def train_folds_stacked(
         for split, it in (("valid", valid_its[k]), ("test", test_it)):
             if len(it) == 0:
                 continue
-            out[split] = _run_eval(
-                eval_step, state_k.params, state_k.batch_stats,
-                it.eval_epoch(global_batch, **eval_kw), mesh,
-            )
+            if use_cache:
+                ck = ("test",) if split == "test" else ("valid", k)
+                if ck not in eval_replay:
+                    eval_replay[ck] = _stacked_eval_splits(
+                        it, global_batch, mesh, eval_kw)
+                out[split] = _run_replay_eval(
+                    replay_eval, state_k.params, state_k.batch_stats,
+                    eval_replay[ck])
+            else:
+                out[split] = _run_eval(
+                    eval_step, state_k.params, state_k.batch_stats,
+                    it.eval_epoch(global_batch, **eval_kw), mesh,
+                )
         return out
 
+    train_cache = DeviceCache(total_train, mesh) if use_cache else None
+    if train_cache is not None:
+        logger.info(
+            "stacked device cache: %d examples (%.1f MiB uint8) resident, "
+            "steps_per_dispatch=%d", train_cache.num_examples,
+            train_cache.nbytes / 2**20, steps_per_dispatch)
+        # the stacked state/keys are already mesh-committed (fold
+        # placement above); the policy tensor must be too, or the first
+        # compile pins a mixed-commitment signature that knocks later
+        # dispatches off the C++ fast path (make_multistep_train_step)
+        pol = jax.device_put(pol, replicated(mesh))
     first_epoch = min(epoch_starts)
     transform = stacked_shard_transform(mesh)
     for epoch in range(first_epoch, epochs + 1):
@@ -619,25 +847,49 @@ def train_folds_stacked(
             [1.0 if epoch >= epoch_starts[k] else 0.0
              for k in range(num_folds)], np.float32)
         ep_act_dev = jnp.asarray(epoch_active)
-        batches = prefetch(
-            stacked_train_batches(
-                total_train, fold_train_idx, global_batch, epoch,
-                seeds=seeds,
-                process_index=jax.process_index(),
-                process_count=jax.process_count(),
-            ),
-            transform=transform,
-        )
         # per-fold sums stay DEVICE-side [K] vectors until epoch end —
         # reading them per batch would sync the dispatch pipeline (the
         # same discipline as the sequential epoch loop)
         epoch_sums: dict | None = None
-        for batch in batches:
-            active = batch["a"] * ep_act_dev
-            stacked, metrics = stacked_step(
-                stacked, batch["x"], batch["y"], pol, keys, active)
-            epoch_sums = metrics if epoch_sums is None else {
-                kk: epoch_sums[kk] + metrics[kk] for kk in epoch_sums}
+        if train_cache is not None:
+            chunks, act = stacked_index_matrix(
+                fold_train_idx, global_batch, epoch, seeds=seeds,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+            act = act * epoch_active[None, :]
+            pos = 0
+            dispatch_metrics: list = []
+            for n in split_dispatch_chunks(len(chunks), steps_per_dispatch):
+                idx_dev, act_dev = place_stacked_index_matrix(
+                    mesh, chunks[pos:pos + n], act[pos:pos + n])
+                stacked, metrics = get_multi_step(n)(
+                    stacked, train_cache.images, train_cache.labels,
+                    idx_dev, pol, keys, act_dev)
+                # async device handles, host-summed at epoch end — a
+                # per-dispatch device add of [K] committed vectors is an
+                # all-participant collective; chains of those wedge the
+                # CPU backend (_sum_metric_dicts / make_replay_eval_step)
+                dispatch_metrics.append(metrics)
+                pos += n
+            if dispatch_metrics:
+                epoch_sums = _sum_metric_dicts(dispatch_metrics)
+        else:
+            batches = prefetch(
+                stacked_train_batches(
+                    total_train, fold_train_idx, global_batch, epoch,
+                    seeds=seeds,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count(),
+                ),
+                transform=transform,
+            )
+            for batch in batches:
+                active = batch["a"] * ep_act_dev
+                stacked, metrics = stacked_step(
+                    stacked, batch["x"], batch["y"], pol, keys, active)
+                epoch_sums = metrics if epoch_sums is None else {
+                    kk: epoch_sums[kk] + metrics[kk] for kk in epoch_sums}
         host_sums = {kk: np.asarray(v)
                      for kk, v in (epoch_sums or {}).items()}
 

@@ -17,12 +17,24 @@ import pytest
 ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                         "search_e2e_r5", "search_result.json")
 
+# ONE loud aggregated skip instead of five quiet per-test ones (ADVICE
+# r5): the r5 artifact is still untracked because the staged three-arm
+# run has not completed — until it is committed, every pin in this file
+# is vacuous and docs/PARITY.md's round-5 "three-way comparison" bullet
+# is PENDING EVIDENCE, not a closed claim.  Committing the artifact
+# (tools/run_search_e2e_r5.sh, then `git add search_e2e_r5/`) turns
+# these back on; they then gate regressions against the committed run.
+if not os.path.exists(ARTIFACT):
+    pytest.skip(
+        "round-5 flagship artifact search_e2e_r5/search_result.json is NOT "
+        "COMMITTED (staged run incomplete) — all five r5 evidence pins are "
+        "inactive and the docs/PARITY.md round-5 three-way-comparison bullet "
+        "is pending; produce and commit it with tools/run_search_e2e_r5.sh",
+        allow_module_level=True)
+
 
 @pytest.fixture(scope="module")
 def artifact():
-    if not os.path.exists(ARTIFACT):
-        pytest.skip("round-5 e2e artifact not present (run "
-                    "tools/run_search_e2e_r5.sh)")
     with open(ARTIFACT) as fh:
         art = json.load(fh)
     # the producer persists after EVERY phase-3 run and declares partial

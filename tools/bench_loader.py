@@ -103,6 +103,77 @@ def bench_feed(paths, target: int, batch: int, depth: int, steps: int) -> float:
     return n / (time.perf_counter() - t0)
 
 
+def bench_gather(n_examples=4096, img=32, batch=256, iters=30) -> dict:
+    """Host-gather vs device-gather per-batch feed latency.
+
+    The two ways a train step gets its batch from an eager dataset:
+
+    - host: numpy fancy-index into the in-RAM array + ``device_put``
+      onto the mesh per step (today's `train_batches` + shard path);
+    - device: the array resident in HBM once (`DeviceCache`), a jitted
+      index gather per step, only the int32 indices crossing the host
+      boundary (`--device-cache`; docs/BENCHMARKS.md "Step dispatch &
+      device cache").
+
+    Emitted as one JSON line so the two feed paths are comparable next
+    to the decode/prefetch numbers above — this is the in-memory
+    (CIFAR) analog of the lazy-decode feed this tool historically
+    benches.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fast_autoaugment_tpu.data.datasets import ArrayDataset
+    from fast_autoaugment_tpu.data.pipeline import DeviceCache
+    from fast_autoaugment_tpu.parallel.mesh import (
+        make_mesh,
+        place_index_matrix,
+        shard_batch,
+    )
+
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(
+        rng.integers(0, 256, (n_examples, img, img, 3), dtype=np.uint8),
+        rng.integers(0, 10, (n_examples,), np.int32), 10)
+    mesh = make_mesh()
+    idx_all = [rng.permutation(n_examples)[:batch] for _ in range(iters)]
+
+    def host_once(idx):
+        b = shard_batch(mesh, {"x": ds.images[idx], "y": ds.labels[idx]})
+        jax.block_until_ready(b["x"])
+        return b
+
+    cache = DeviceCache(ds, mesh)
+    gather = jax.jit(lambda xs, ys, i: (jnp.take(xs, i, axis=0),
+                                        jnp.take(ys, i, axis=0)))
+
+    def device_once(idx):
+        x, y = gather(cache.images, cache.labels,
+                      place_index_matrix(mesh, idx))
+        jax.block_until_ready(x)
+        return x
+
+    host_once(idx_all[0])  # warm any layout/transfer paths
+    device_once(idx_all[0])  # compile outside the timed loop
+    t0 = time.perf_counter()
+    for idx in idx_all:
+        host_once(idx)
+    host_ms = (time.perf_counter() - t0) / iters * 1e3
+    t0 = time.perf_counter()
+    for idx in idx_all:
+        device_once(idx)
+    device_ms = (time.perf_counter() - t0) / iters * 1e3
+    return {
+        "metric": "feed_gather_ms_per_batch",
+        "host_gather_device_put_ms": round(host_ms, 3),
+        "device_resident_gather_ms": round(device_ms, 3),
+        "speedup_device_vs_host": round(host_ms / device_ms, 2)
+        if device_ms else None,
+        "probe": {"n_examples": n_examples, "image": img, "batch": batch,
+                  "iters": iters, "devices": mesh.size},
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--dir", default="/tmp/faa_loader_bench")
@@ -168,6 +239,12 @@ def main(argv=None):
         r = bench_feed(paths, args.target, args.batch, depth, steps)
         depth_rows[depth] = r
         print(f"feed depth={depth}:  {r:8.1f} img/s")
+
+    # eager-dataset feed paths: host fancy-gather + device_put vs the
+    # device-resident cache gather, one comparable JSON line
+    gather = bench_gather()
+    gather["contention"] = contention
+    print(json.dumps(gather))
 
     if args.report:
         with open(args.report, "w") as fh:

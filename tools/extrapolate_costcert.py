@@ -62,6 +62,20 @@ def main(argv=None) -> int:
                         "(VERDICT r4 weak 3)")
     p.add_argument("--fold0-epochs", type=int, default=30)
     p.add_argument("--fold0-trials", type=int, default=25)
+    p.add_argument("--target-selected-subs", type=int, default=None,
+                   help="expected SELECTED sub-policy count at the target "
+                        "trial budget (the audit evaluates each selected "
+                        "sub alone, so its cost scales with this count, "
+                        "not just the fold count — ADVICE r5).  When "
+                        "given (with the artifact's own selected count, "
+                        "or --fold0-selected-subs), the audit projection "
+                        "scales by selected-subs x folds; omitted, the "
+                        "projection assumes the measured run's count and "
+                        "SAYS SO in projection_basis")
+    p.add_argument("--fold0-selected-subs", type=int, default=None,
+                   help="override the fold0 artifact's recorded "
+                        "num_sub_policies_selected as the audit unit-cost "
+                        "divisor")
     p.add_argument("--tta-bench-cpu", default=None,
                    help="tools/bench_tta.py JSON measured on this host")
     p.add_argument("--tta-bench-tpu", default=None,
@@ -100,14 +114,24 @@ def main(argv=None) -> int:
     audit_secs = audit
     unit_source = "costcert (2-epoch oracles, audit borrowed)"
     out = {"metric": "refscale_search_cost_projection", "measured": measured}
+    audit_subs_measured = None
     if args.fold0_dir:
         f0 = _load_result(args.fold0_dir)
         f0_p1, f0_p2 = f0["tpu_secs_phase1"], f0["tpu_secs_phase2"]
         f0_audit = f0.get("tpu_secs_audit", 0.0)
         secs_per_epoch_fold = f0_p1 / max(args.fold0_epochs, 1)
         secs_per_trial = f0_p2 / max(args.fold0_trials, 1)
-        # audit cost scales with the number of folds it scores against
+        # audit cost scales with folds x SELECTED sub-policies (each
+        # selected sub is scored alone on every gated fold); the fold
+        # count is known, the selected count at a 200-trial budget is
+        # not — project it when the caller supplies an expectation,
+        # otherwise assume the measured count and record the assumption
+        # (ADVICE r5: the old folds-only scaling was silently optimistic)
+        audit_subs_measured = (args.fold0_selected_subs
+                               or f0.get("num_sub_policies_selected"))
         audit_secs = f0_audit * args.target_folds
+        if args.target_selected_subs and audit_subs_measured:
+            audit_secs *= args.target_selected_subs / audit_subs_measured
         unit_source = (
             f"fold0 depth run ({args.fold0_epochs}-epoch oracle, "
             f"{args.fold0_trials} trials, audit EXECUTED)")
@@ -117,9 +141,30 @@ def main(argv=None) -> int:
             "phase2_secs": round(f0_p2, 1),
             "secs_per_trial": round(secs_per_trial, 2),
             "audit_secs": round(f0_audit, 1),
+            "audit_selected_subs": audit_subs_measured,
             "oracle_baseline": f0.get("fold_baselines", {}).get("0"),
             "backend": f0.get("backend", "unrecorded"),
         }
+
+    if not args.fold0_dir:
+        audit_basis = (
+            "costcert run's audit cost carried over UNSCALED (its audit ran "
+            "over the truncated search's selected subs on its own folds) — "
+            "both the fold count and the selected-sub-policy count at the "
+            "target budget are unmodeled here; prefer --fold0-dir with "
+            "--target-selected-subs for a defensible audit term")
+    elif args.target_selected_subs and audit_subs_measured:
+        audit_basis = (
+            f"measured audit cost x {args.target_folds} folds x "
+            f"({args.target_selected_subs} expected selected subs / "
+            f"{audit_subs_measured} measured)")
+    else:
+        audit_basis = (
+            f"measured audit cost x {args.target_folds} folds, ASSUMING the "
+            "selected-sub-policy count stays at the measured run's"
+            + (f" ({audit_subs_measured})" if audit_subs_measured else "")
+            + " — a full trial budget typically selects more subs, so this "
+              "term is optimistic; pass --target-selected-subs to scale it")
 
     p1_full = secs_per_epoch_fold * args.target_epochs * args.target_folds
     p2_full = secs_per_trial * args.target_trials * args.target_folds
@@ -131,7 +176,7 @@ def main(argv=None) -> int:
                   "x measured per-epoch cost",
         "phase2": f"{args.target_folds} folds x {args.target_trials} trials "
                   "x measured per-trial cost (single compiled executable)",
-        "audit": "measured audit cost scaled to the target fold count",
+        "audit": audit_basis,
     }
     if args.tpu_speedup:
         # train-shape ratio for phase 1; TTA-shape ratio for phase 2 +
