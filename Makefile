@@ -1,15 +1,22 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 native bench bench-aug bench-dispatch clean reproduce
+.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
+# AST robustness lint (docs/RESILIENCE.md): bare excepts, swallowed
+# broad excepts, and run-artifact writes that bypass the atomic
+# helpers.  Pure-host, sub-second.
+lint-robust:
+	python tools/lint_robustness.py
+
 # the tier-1 verify command, verbatim from ROADMAP.md (the plain `test`
 # target differs: it includes slow-marked tests and stops on collection
-# errors) — this is the gate the driver actually runs
-test-t1:
+# errors) — this is the gate the driver actually runs, with the
+# robustness lint as a preamble
+test-t1: lint-robust
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # real-data fire-drill (VERDICT r3, next-step 8): fetch CIFAR-10 with

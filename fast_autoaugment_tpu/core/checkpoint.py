@@ -12,40 +12,163 @@ sidecar, so progress polling never touches tensor bytes
 Writes are atomic (tmp + rename) so a concurrently-polling reader never
 sees a torn file — the reference guards this with bare ``except``
 retries instead (``search.py:191-192``).
+
+Integrity + rollback (docs/RESILIENCE.md): every save stamps a sha256
+content digest and the payload size into the sidecar and rotates a
+bounded restore chain (``path``, ``path.prev``, ``path.prev2``, …,
+depth ``keep``); :func:`load_checkpoint` verifies the digest and raises
+:class:`~fast_autoaugment_tpu.core.resilience.CheckpointCorruptError`
+on mismatch, and :func:`load_checkpoint_chain` walks back to the newest
+intact snapshot — one torn/corrupt file costs an epoch, not the run.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any
+from typing import Any, Callable
 
 from flax import serialization
 
-__all__ = ["save_checkpoint", "load_checkpoint", "read_metadata", "checkpoint_exists"]
+from fast_autoaugment_tpu.core.resilience import CheckpointCorruptError
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "load_checkpoint_chain",
+    "read_metadata",
+    "checkpoint_exists",
+    "chain_paths",
+    "CheckpointCorruptError",
+]
+
+logger = get_logger("faa_tpu.checkpoint")
+
+#: default rollback-chain depth (the live file plus one predecessor)
+DEFAULT_KEEP = 2
 
 
 def _meta_path(path: str) -> str:
     return path + ".meta.json"
 
 
-def save_checkpoint(path: str, state: Any, metadata: dict | None = None):
+def chain_paths(path: str, keep: int = DEFAULT_KEEP) -> list[str]:
+    """The restore-chain filenames, newest first: ``path``,
+    ``path.prev``, ``path.prev2``, …  (``keep`` total links)."""
+    out = [path]
+    for i in range(1, max(1, keep)):
+        out.append(path + (".prev" if i == 1 else f".prev{i}"))
+    return out
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def _rotate_chain(path: str, keep: int) -> None:
+    """Shift ``path`` -> ``path.prev`` -> … before a new save lands.
+
+    Each payload/sidecar move is an atomic ``os.replace``; the pair is
+    not atomic, but a crash between the two leaves a digest mismatch
+    the chain walk detects and skips (docs/RESILIENCE.md, "torn
+    rotation").
+    """
+    links = chain_paths(path, keep)
+    # oldest link falls off the end; move back-to-front
+    for newer, older in zip(reversed(links[:-1]), reversed(links[1:])):
+        for suffix in ("", ".meta.json"):
+            src, dst = newer + suffix, older + suffix
+            if os.path.exists(src):
+                os.replace(src, dst)
+            elif os.path.exists(dst):
+                # a fresh pair must never sit next to a stale leftover
+                os.remove(dst)
+
+
+def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
+                    keep: int = DEFAULT_KEEP):
     """Serialize `state` (any pytree) to `path` atomically; write the
-    JSON `metadata` sidecar after the payload is in place."""
+    JSON `metadata` sidecar (stamped with the payload's sha256 digest
+    and byte size) after the payload is in place.  ``keep >= 2`` first
+    rotates the existing checkpoint into the rollback chain
+    (:func:`chain_paths`); ``keep=1`` overwrites in place (the
+    pre-chain behavior)."""
+    from fast_autoaugment_tpu.utils import faultinject
+
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     payload = serialization.to_bytes(state)
+    meta = dict(metadata or {})
+    meta["digest"] = _digest(payload)
+    meta["nbytes"] = len(payload)
+
+    fi = faultinject.active_plan()
+    if fi is not None:
+        save_n = fi.next_save()
+        if fi.torn_at(save_n):
+            # simulate a torn non-atomic write: half the payload lands
+            # under the FULL payload's digest, then the "process died" —
+            # the chain is rotated first, exactly like a real crash
+            # mid-save after rotation
+            _rotate_chain(path, keep)
+            with open(path, "wb") as fh:
+                fh.write(payload[: max(1, len(payload) // 2)])
+            with open(_meta_path(path), "w") as fh:
+                json.dump(meta, fh)
+            return
+        if fi.corrupt_at(save_n):
+            # silent bit-rot: flip bytes AFTER the digest was computed
+            corrupted = bytearray(payload)
+            corrupted[len(corrupted) // 2] ^= 0xFF
+            payload = bytes(corrupted)
+
+    if keep >= 2:
+        _rotate_chain(path, keep)
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         fh.write(payload)
     os.replace(tmp, path)
-    meta = dict(metadata or {})
     tmp_meta = _meta_path(path) + ".tmp"
     with open(tmp_meta, "w") as fh:
         json.dump(meta, fh)
     os.replace(tmp_meta, _meta_path(path))
 
 
-def load_checkpoint(path: str, target: Any, lenient: bool = False) -> Any:
+def _read_payload(path: str) -> bytes:
+    from fast_autoaugment_tpu.utils import faultinject
+
+    fi = faultinject.active_plan()
+    if fi is not None and fi.io_error_now():
+        raise OSError(f"injected I/O error reading {path}")
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def _verify_payload(path: str, payload: bytes) -> None:
+    """Check the payload against its sidecar's digest/size stamps.
+
+    Pre-chain checkpoints (no ``digest`` key) pass unverified — their
+    sidecars never carried one.  A missing sidecar also passes: callers
+    that require it gate on :func:`checkpoint_exists` first.
+    """
+    meta = read_metadata(path)
+    if meta is None:
+        return
+    nbytes = meta.get("nbytes")
+    if nbytes is not None and int(nbytes) != len(payload):
+        raise CheckpointCorruptError(
+            f"{path}: payload is {len(payload)} bytes, sidecar says "
+            f"{nbytes} (torn write?)")
+    digest = meta.get("digest")
+    if digest is not None and _digest(payload) != digest:
+        raise CheckpointCorruptError(
+            f"{path}: payload sha256 {_digest(payload)[:12]}… does not "
+            f"match sidecar digest {str(digest)[:12]}…")
+
+
+def load_checkpoint(path: str, target: Any, lenient: bool = False,
+                    verify: bool = True) -> Any:
     """Restore a pytree of the same structure as `target` from `path`.
 
     `lenient` merges only the fields present in the file onto the
@@ -53,9 +176,14 @@ def load_checkpoint(path: str, target: Any, lenient: bool = False) -> Any:
     format, which carry params/batch_stats/ema but no optimizer state —
     the analog of the reference's raw-state-dict handling,
     ``train.py:191-204``).
+
+    `verify` (default) checks the payload against the sidecar's sha256
+    digest and size and raises :class:`CheckpointCorruptError` on
+    mismatch; pre-digest checkpoints pass through unchecked.
     """
-    with open(path, "rb") as fh:
-        payload = fh.read()
+    payload = _read_payload(path)
+    if verify:
+        _verify_payload(path, payload)
     if not lenient:
         return serialization.from_bytes(target, payload)
 
@@ -78,18 +206,83 @@ def load_checkpoint(path: str, target: Any, lenient: bool = False) -> Any:
     return serialization.from_state_dict(target, merge(template, raw))
 
 
+def load_checkpoint_chain(
+    path: str,
+    target: Any,
+    *,
+    lenient: bool = False,
+    keep: int = DEFAULT_KEEP,
+    accept: Callable[[dict], bool] | None = None,
+) -> tuple[Any, dict, str] | None:
+    """Restore from the NEWEST intact link of `path`'s rollback chain.
+
+    Walks ``path``, ``path.prev``, … skipping links that are missing,
+    corrupt (digest/size mismatch), unreadable, or rejected by the
+    `accept` predicate on their metadata — each skip is logged loudly
+    with the reason, so an operator can see exactly what a recovery
+    cost.  Returns ``(state, metadata, used_path)`` or ``None`` when no
+    link survives.
+    """
+    for link in chain_paths(path, keep):
+        if not checkpoint_exists(link):
+            continue
+        meta = read_metadata(link) or {}
+        if accept is not None and not accept(meta):
+            logger.warning(
+                "restore chain: skipping %s (metadata rejected: epoch=%s"
+                "%s)", link, meta.get("epoch"),
+                ", mid-epoch snapshot" if "in_epoch" in meta else "")
+            continue
+        try:
+            state = load_checkpoint(link, target, lenient=lenient)
+        except CheckpointCorruptError as e:
+            logger.warning("restore chain: skipping CORRUPT link %s (%s)",
+                           link, e)
+            continue
+        except OSError as e:
+            logger.warning("restore chain: skipping unreadable link %s (%s)",
+                           link, e)
+            continue
+        if link != path:
+            logger.warning(
+                "restore chain: recovered from OLDER link %s (epoch %s) — "
+                "newer link(s) were corrupt or rejected",
+                link, meta.get("epoch"))
+        return state, meta, link
+    return None
+
+
 def read_metadata(path: str) -> dict | None:
     """Read the metadata sidecar without touching tensor bytes.
 
-    Returns None if the checkpoint (or sidecar) does not exist yet —
-    callers poll this during search phase 1.
+    Returns None if the checkpoint (or sidecar) does not exist yet, or
+    if the sidecar is unreadable/torn — callers poll this during search
+    phase 1 and must never crash on a file mid-write by another
+    process.
     """
+    from fast_autoaugment_tpu.utils import faultinject
+
+    fi = faultinject.active_plan()
+    if fi is not None and fi.io_error_now():
+        return None
     try:
         with open(_meta_path(path)) as fh:
             return json.load(fh)
-    except (FileNotFoundError, json.JSONDecodeError):
+    except (OSError, json.JSONDecodeError):
+        # OSError covers FileNotFoundError plus the transient read
+        # failures (EIO, stale NFS handles) the docstring promises to
+        # absorb; a torn sidecar surfaces as JSONDecodeError
         return None
 
 
 def checkpoint_exists(path: str) -> bool:
-    return os.path.exists(path) and os.path.exists(_meta_path(path))
+    """True when `path` holds a plausibly-restorable checkpoint: a
+    NONZERO payload plus a parseable metadata sidecar.  A zero-byte
+    payload left by a crashed pre-atomic-write process (or a payload
+    whose sidecar never landed) does not count."""
+    try:
+        if os.path.getsize(path) == 0:
+            return False
+    except OSError:
+        return False
+    return read_metadata(path) is not None

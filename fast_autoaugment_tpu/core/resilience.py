@@ -1,0 +1,112 @@
+"""Fault-tolerance primitives: typed failures, the preemption contract.
+
+Fast AutoAugment's three-phase pipeline is exactly the long-running
+multi-host workload where TPU preemption, torn checkpoints and diverged
+trials are routine (PAPERS.md: *Scalable Training of Language Models
+using JAX pjit and TPUv4* treats preemption-tolerant checkpoint/restore
+as a first-class subsystem; *Podracer architectures* requires workers to
+survive individual failures without losing fleet progress).  This
+module holds the pieces every layer shares:
+
+- **typed failures** — :class:`CheckpointCorruptError` (digest/size
+  mismatch or unreadable payload, raised by ``core/checkpoint.py``) and
+  :class:`PreemptedError` (a graceful shutdown request was honored; the
+  process should exit :data:`PREEMPTED_EXIT_CODE` so supervisors map it
+  to "resume me", not "failed");
+- **the preemption flag** — :func:`install_signal_handlers` registers
+  SIGTERM/SIGUSR1 handlers that only set a flag; the training loops
+  poll :func:`preemption_requested` at dispatch-chunk boundaries (the
+  PR-4 boundaries already guarantee resumability there), checkpoint
+  with ``preempted: true`` metadata and raise :class:`PreemptedError`;
+- **exit-code contract** — exit 77 == preempted-and-checkpointed.  77
+  is outside the shell (126+) and signal (128+N) ranges and collides
+  with nothing the CLIs emit today; ``launch/fleet.py`` treats it as
+  retry-eligible.
+
+See docs/RESILIENCE.md for the full failure taxonomy and the
+deterministic fault-injection harness (``utils/faultinject.py``) that
+drives every recovery path in tests.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = [
+    "PREEMPTED_EXIT_CODE",
+    "CheckpointCorruptError",
+    "PreemptedError",
+    "install_signal_handlers",
+    "preemption_requested",
+    "request_preemption",
+    "clear_preemption",
+]
+
+logger = get_logger("faa_tpu.resilience")
+
+#: exit code meaning "preempted: state checkpointed, resume me"
+PREEMPTED_EXIT_CODE = 77
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint payload failed its integrity check (digest or size
+    mismatch against the ``.meta.json`` sidecar, or an unreadable /
+    truncated payload).  ``load_checkpoint_chain`` treats this as "walk
+    back one link"; bare ``load_checkpoint`` propagates it."""
+
+
+class PreemptedError(RuntimeError):
+    """A SIGTERM/SIGUSR1 shutdown request was honored at a safe
+    boundary: state is checkpointed (``preempted: true`` metadata) and
+    the process should exit :data:`PREEMPTED_EXIT_CODE`."""
+
+    exit_code = PREEMPTED_EXIT_CODE
+
+
+# -- the preemption flag ----------------------------------------------
+# A plain Event, set from the signal handler (handlers must not do I/O
+# or grab locks); every reader polls it at safe boundaries.
+_preempt_flag = threading.Event()
+_handlers_installed = False
+
+
+def _handler(signum, frame):  # pragma: no cover — exercised via os.kill
+    # flag-only: the epoch/dispatch loop does the actual checkpoint +
+    # exit at its next safe boundary
+    _preempt_flag.set()
+
+
+def install_signal_handlers(signals=(signal.SIGTERM, signal.SIGUSR1)) -> bool:
+    """Install the flag-setting preemption handlers.  Idempotent;
+    returns False (and changes nothing) off the main thread, where
+    CPython forbids ``signal.signal``."""
+    global _handlers_installed
+    if _handlers_installed:
+        return True
+    try:
+        for s in signals:
+            signal.signal(s, _handler)
+    except ValueError:  # not the main thread — caller keeps polling a
+        logger.warning(  # flag that only request_preemption() can set
+            "preemption handlers not installed (not on the main thread)")
+        return False
+    _handlers_installed = True
+    return True
+
+
+def preemption_requested() -> bool:
+    """True once a shutdown signal arrived (or request_preemption ran)."""
+    return _preempt_flag.is_set()
+
+
+def request_preemption() -> None:
+    """Set the preemption flag programmatically (tests, embedders)."""
+    _preempt_flag.set()
+
+
+def clear_preemption() -> None:
+    """Reset the flag (a new run in the same process starts clean)."""
+    _preempt_flag.clear()

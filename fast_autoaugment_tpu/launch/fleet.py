@@ -17,8 +17,23 @@ hygiene, provided here natively:
 - local SIGINT/SIGTERM (and normal exit) fan out kills to every host;
 - remote stdout/stderr is streamed line-by-line with a ``[host]``
   prefix (``safe_shell_exec.py:63-87``);
-- non-zero exit on any host tears the fleet down and propagates the
+- a host that fails FOR GOOD tears the fleet down and propagates the
   exit code (``train_dist.py:15-27``).
+
+Resilience additions (docs/RESILIENCE.md):
+
+- ``--host-retries N`` (default 0 = the historical tear-down-on-first-
+  failure) relaunches a failed host up to N times with exponential
+  backoff before giving up; a preempted host (exit
+  :data:`~fast_autoaugment_tpu.core.resilience.PREEMPTED_EXIT_CODE`,
+  77) is explicitly retry-eligible — its training checkpointed before
+  exiting, so the relaunch RESUMES rather than restarts;
+- the fleet's exit code is the FIRST GENUINE failure: hosts that die
+  from the teardown kill (or only ever exited 0/77-retried) no longer
+  mask the root cause — the old ``worst = worst or code`` could report
+  a teardown-induced SIGTERM instead of the real failing host when
+  wait order and failure order disagreed;
+- the final log line reports per-host attempt counts.
 
     python -m fast_autoaugment_tpu.launch.fleet --hosts host1,host2,host3,host4 \
         --coordinator host1:8476 -- python -m fast_autoaugment_tpu.launch.train_cli \
@@ -37,7 +52,9 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 
+from fast_autoaugment_tpu.core.resilience import PREEMPTED_EXIT_CODE
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 logger = get_logger("faa_tpu.fleet")
@@ -52,24 +69,47 @@ def expand_hosts(spec: str) -> list[str]:
     return [h.strip() for h in spec.split(",") if h.strip()]
 
 
+def _remote_argv(host: str, wire: str) -> list[str]:
+    """The local argv that runs `wire` on `host` (separate function so
+    tests can substitute a local shell for ssh)."""
+    return ["ssh", "-tt", "-o", "BatchMode=yes", host, wire]
+
+
 class _Fleet:
     def __init__(self):
-        self.procs: list[subprocess.Popen] = []
-        self.failed: dict[str, int] = {}
+        self.procs: set[subprocess.Popen] = set()
         self._lock = threading.Lock()
+        # once set, new launches stop and in-flight failures are
+        # recorded as teardown-induced rather than root causes
+        self.teardown = threading.Event()
+        # (monotonic time, host, code) of genuine failures, in order
+        self.failures: list[tuple[float, str, int]] = []
+
+    def track(self, p: subprocess.Popen):
+        with self._lock:
+            self.procs.add(p)
+
+    def untrack(self, p: subprocess.Popen):
+        with self._lock:
+            self.procs.discard(p)
+
+    def record_failure(self, host: str, code: int):
+        with self._lock:
+            self.failures.append((time.monotonic(), host, code))
 
     def kill_all(self, sig=signal.SIGTERM):
         with self._lock:
-            for p in self.procs:
-                if p.poll() is None:
-                    try:
-                        # the local ssh runs in its own session; killing it
-                        # closes the remote pty, and the kernel HUPs the
-                        # remote foreground process group (the command tree
-                        # is deliberately NOT setsid-detached from the pty)
-                        os.killpg(os.getpgid(p.pid), sig)
-                    except (ProcessLookupError, PermissionError):
-                        pass
+            procs = list(self.procs)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    # the local ssh runs in its own session; killing it
+                    # closes the remote pty, and the kernel HUPs the
+                    # remote foreground process group (the command tree
+                    # is deliberately NOT setsid-detached from the pty)
+                    os.killpg(os.getpgid(p.pid), sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
 
 
 def _stream(host: str, pipe, out):
@@ -79,69 +119,143 @@ def _stream(host: str, pipe, out):
     pipe.close()
 
 
-def launch_fleet(hosts: list[str], command: list[str], coordinator: str | None,
-                 env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",)) -> int:
-    """Run `command` on every host over SSH; returns the worst exit code."""
-    fleet = _Fleet()
-    coordinator = coordinator or f"{hosts[0]}:8476"
-
-    def handler(signum, frame):
-        logger.info("signal %d: killing fleet", signum)
-        fleet.kill_all(signal.SIGTERM)
-        sys.exit(128 + signum)
-
-    signal.signal(signal.SIGINT, handler)
-    signal.signal(signal.SIGTERM, handler)
-
-    threads = []
-    for host_id, host in enumerate(hosts):
-        remote_cmd = command + [
-            "--coordinator", coordinator,
-            "--num-hosts", str(len(hosts)),
-            "--host-id", str(host_id),
-        ]
-        envs = " ".join(
-            f"{k}={shlex.quote(os.environ[k])}" for k in env_passthrough if k in os.environ
-        )
-        # NO setsid: the remote command must keep the ssh pty as its
-        # controlling terminal so pty teardown HUPs the whole foreground
-        # group — a setsid-detached tree would never see the hangup and
-        # Ctrl-C here would orphan remote training processes
-        # (safe_shell_exec.py:98-131 solves the same problem with an
-        # explicit signal-forwarding middleman)
-        wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec " + " ".join(
-            shlex.quote(c) for c in remote_cmd
-        )
-        full = ["ssh", "-tt", "-o", "BatchMode=yes", host, wire]
-        logger.info("[%s] %s", host, " ".join(full))
+def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
+               coordinator: str, num_hosts: int,
+               env_passthrough: tuple[str, ...], host_retries: int,
+               retry_backoff: float, attempts_out: dict):
+    """Launch + babysit one host: relaunch on failure (exit 77 included)
+    up to `host_retries` times with exponential backoff; on final
+    failure record the code and trigger fleet teardown."""
+    remote_cmd = command + [
+        "--coordinator", coordinator,
+        "--num-hosts", str(num_hosts),
+        "--host-id", str(host_id),
+    ]
+    envs = " ".join(
+        f"{k}={shlex.quote(os.environ[k])}"
+        for k in env_passthrough if k in os.environ
+    )
+    # NO setsid: the remote command must keep the ssh pty as its
+    # controlling terminal so pty teardown HUPs the whole foreground
+    # group — a setsid-detached tree would never see the hangup and
+    # Ctrl-C here would orphan remote training processes
+    # (safe_shell_exec.py:98-131 solves the same problem with an
+    # explicit signal-forwarding middleman)
+    wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec " + " ".join(
+        shlex.quote(c) for c in remote_cmd
+    )
+    attempt = 0
+    while not fleet.teardown.is_set():
+        attempt += 1
+        attempts_out[host] = attempt
+        full = _remote_argv(host, wire)
+        logger.info("[%s] (attempt %d) %s", host, attempt, " ".join(full))
         try:
             p = subprocess.Popen(
                 full, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 start_new_session=True,
             )
         except FileNotFoundError:
-            logger.error("ssh binary not found — the fleet launcher needs an "
-                         "ssh client on the controlling host")
+            logger.error("ssh binary not found — the fleet launcher needs "
+                         "an ssh client on the controlling host")
+            fleet.record_failure(host, 127)
+            fleet.teardown.set()
             fleet.kill_all()
-            return 127
-        fleet.procs.append(p)
-        t = threading.Thread(target=_stream, args=(host, p.stdout, sys.stdout.buffer),
+            return
+        fleet.track(p)
+        t = threading.Thread(target=_stream,
+                             args=(host, p.stdout, sys.stdout.buffer),
                              daemon=True)
         t.start()
-        threads.append(t)
-
-    worst = 0
-    try:
-        for host, p in zip(hosts, fleet.procs):
-            code = p.wait()
-            if code != 0:
-                logger.warning("[%s] exited %d — tearing down fleet", host, code)
-                worst = worst or code
-                fleet.kill_all()
-    finally:
+        code = p.wait()
+        t.join(timeout=2)
+        fleet.untrack(p)
+        if code == 0:
+            return
+        if fleet.teardown.is_set():
+            # killed by (or failed during) teardown: NOT a root cause
+            logger.info("[%s] exited %d during teardown", host, code)
+            return
+        preempted = code == PREEMPTED_EXIT_CODE
+        if attempt <= host_retries:
+            delay = retry_backoff * (2 ** (attempt - 1))
+            logger.warning(
+                "[%s] exited %d (%s) — relaunching in %.1fs "
+                "(attempt %d/%d)", host, code,
+                "preempted: resume me" if preempted else "failed",
+                delay, attempt, host_retries + 1)
+            # interruptible sleep: a teardown elsewhere aborts the retry
+            if fleet.teardown.wait(delay):
+                return
+            continue
+        logger.warning("[%s] exited %d (%s) — out of retries, tearing "
+                       "down fleet", host, code,
+                       "preempted" if preempted else "failed")
+        fleet.record_failure(host, code)
+        fleet.teardown.set()
         fleet.kill_all()
-        for t in threads:
-            t.join(timeout=2)
+        return
+
+
+def launch_fleet(hosts: list[str], command: list[str],
+                 coordinator: str | None,
+                 env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",),
+                 host_retries: int = 0,
+                 retry_backoff: float = 1.0) -> int:
+    """Run `command` on every host over SSH; returns the first genuine
+    failure's exit code (0 when every host eventually succeeds).
+
+    `host_retries` relaunches a failed host (exponential backoff
+    starting at `retry_backoff` seconds) before the failure counts;
+    exit 77 (preempted — state checkpointed, docs/RESILIENCE.md) is
+    retry-eligible like any failure, and the relaunch resumes from the
+    checkpoint."""
+    fleet = _Fleet()
+    coordinator = coordinator or f"{hosts[0]}:8476"
+    host_retries = max(0, int(host_retries))
+
+    def handler(signum, frame):
+        logger.info("signal %d: killing fleet", signum)
+        fleet.teardown.set()
+        fleet.kill_all(signal.SIGTERM)
+        sys.exit(128 + signum)
+
+    prev_int = signal.signal(signal.SIGINT, handler)
+    prev_term = signal.signal(signal.SIGTERM, handler)
+
+    attempts: dict[str, int] = {}
+    supervisors = []
+    for host_id, host in enumerate(hosts):
+        t = threading.Thread(
+            target=_supervise,
+            args=(fleet, host_id, host, command, coordinator, len(hosts),
+                  env_passthrough, host_retries, retry_backoff, attempts),
+            daemon=True,
+        )
+        t.start()
+        supervisors.append(t)
+    try:
+        for t in supervisors:
+            t.join()
+    finally:
+        fleet.teardown.set()
+        fleet.kill_all()
+        # restore whatever handlers the embedding process had (e.g. the
+        # resilience preemption handlers when launched in-process)
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
+    # first GENUINE failure wins: teardown-induced exits were never
+    # recorded, so a late sibling killed with SIGTERM cannot mask (or
+    # be masked by) the root cause
+    worst = 0
+    if fleet.failures:
+        fleet.failures.sort(key=lambda f: f[0])
+        _, first_host, worst = fleet.failures[0]
+        logger.warning("fleet: first genuine failure on [%s] with exit %d",
+                       first_host, worst)
+    logger.info(
+        "fleet done: exit %d; attempts per host: %s", worst,
+        " ".join(f"{h}={attempts.get(h, 0)}" for h in hosts))
     return worst
 
 
@@ -149,6 +263,13 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="multi-host launcher")
     p.add_argument("--hosts", required=True, help="N or comma-separated hostnames")
     p.add_argument("--coordinator", default=None, help="addr:port of host 0")
+    p.add_argument("--host-retries", type=int, default=0,
+                   help="relaunch a failed host up to N times (exponential "
+                        "backoff) before tearing down the fleet; exit 77 "
+                        "(preempted, checkpointed) is retry-eligible and "
+                        "the relaunch RESUMES (docs/RESILIENCE.md)")
+    p.add_argument("--retry-backoff", type=float, default=1.0,
+                   help="base seconds for the exponential retry backoff")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run on every host (prefix with --)")
     args = p.parse_args(argv)
@@ -158,7 +279,9 @@ def main(argv=None):
     if not command:
         p.error("no command given")
     hosts = expand_hosts(args.hosts)
-    code = launch_fleet(hosts, command, args.coordinator)
+    code = launch_fleet(hosts, command, args.coordinator,
+                        host_retries=args.host_retries,
+                        retry_backoff=args.retry_backoff)
     sys.exit(code)
 
 

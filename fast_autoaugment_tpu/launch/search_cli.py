@@ -18,6 +18,11 @@ import time
 import numpy as np
 
 from fast_autoaugment_tpu.core.config import load_config
+from fast_autoaugment_tpu.core.resilience import (
+    PREEMPTED_EXIT_CODE,
+    PreemptedError,
+    install_signal_handlers,
+)
 from fast_autoaugment_tpu.search.driver import search_policies, write_json_atomic
 from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import get_logger
@@ -169,6 +174,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "audited identically, retrained on the same seeds "
                         "(the density-matching claim is searched > random, "
                         "not just searched > no-aug)")
+    p.add_argument("--divergence-retries", type=int, default=0,
+                   help="phase-1/3 training runs: on a NaN/inf epoch "
+                        "loss, roll back to the newest intact checkpoint "
+                        "and replay with retry-folded randomness up to R "
+                        "times before re-raising.  0 (default) = the "
+                        "historical immediate raise (docs/RESILIENCE.md)")
+    p.add_argument("--ckpt-keep", type=int, default=2,
+                   help="rollback-chain depth for every checkpoint this "
+                        "search writes (path, path.prev, ...); restore "
+                        "walks to the newest sha256-intact link")
+    p.add_argument("--ckpt-every-dispatch", type=int, default=0,
+                   help="mid-epoch snapshot every M dispatch chunks in "
+                        "phase-3 retrains (device-cache path; bit-"
+                        "identical dispatch-boundary resume).  0 = off")
     p.add_argument("--audit-floor", type=float, default=0.95,
                    help="drop selected sub-policies whose standalone "
                         "mean-over-draws fold accuracy < floor x baseline "
@@ -183,8 +202,23 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None):
     args = build_parser().parse_args(argv)
     conf = load_config(args.conf, overrides=args.override)
+    # SIGTERM/SIGUSR1 -> graceful preemption: the in-flight training run
+    # checkpoints at its next safe boundary (per-trial logs are already
+    # persisted per round) and the process exits 77 = "resume me"
+    install_signal_handlers()
     t_start = time.time()
 
+    try:
+        return _run(args, conf, t_start)
+    except PreemptedError as e:
+        logger.warning(
+            "preempted (%s) — exiting %d; rerunning the same command "
+            "resumes from the per-fold checkpoints and trial log",
+            e, PREEMPTED_EXIT_CODE)
+        raise SystemExit(PREEMPTED_EXIT_CODE)
+
+
+def _run(args, conf, t_start):
     result = search_policies(
         conf,
         dataroot=args.dataroot,
@@ -211,6 +245,8 @@ def main(argv=None):
         aug_groups=args.aug_groups,
         device_cache=args.device_cache,
         steps_per_dispatch=args.steps_per_dispatch,
+        divergence_retries=args.divergence_retries,
+        ckpt_keep=args.ckpt_keep,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []
@@ -303,6 +339,9 @@ def main(argv=None):
                 aug_dispatch=args.aug_dispatch, aug_groups=args.aug_groups,
                 device_cache=args.device_cache,
                 steps_per_dispatch=args.steps_per_dispatch,
+                divergence_retries=args.divergence_retries,
+                ckpt_keep=args.ckpt_keep,
+                checkpoint_every_dispatch=args.ckpt_every_dispatch,
             )
             outcomes[mode].append(float(res.get("top1_test", 0.0)))
             logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,

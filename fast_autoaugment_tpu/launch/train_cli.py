@@ -17,6 +17,11 @@ import json
 import time
 
 from fast_autoaugment_tpu.core.config import load_config
+from fast_autoaugment_tpu.core.resilience import (
+    PREEMPTED_EXIT_CODE,
+    PreemptedError,
+    install_signal_handlers,
+)
 from fast_autoaugment_tpu.train.trainer import train_and_eval
 from fast_autoaugment_tpu.utils.logging import add_filehandler, get_logger
 
@@ -63,6 +68,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "one-dispatch-per-step loop bit-for-bit; N>1 "
                         "deviates by the documented ~1 f32 ULP/step scan "
                         "bound and amortizes per-dispatch host overhead")
+    p.add_argument("--divergence-retries", type=int, default=0,
+                   help="on a NaN/inf epoch loss, roll back to the newest "
+                        "intact checkpoint and replay with retry-folded "
+                        "randomness up to R times before re-raising.  0 "
+                        "(default) = the historical immediate raise "
+                        "(docs/RESILIENCE.md)")
+    p.add_argument("--ckpt-keep", type=int, default=2,
+                   help="rollback-chain depth: the live checkpoint plus "
+                        "N-1 predecessors (path, path.prev, ...).  Restore "
+                        "walks to the newest INTACT link (sha256-verified), "
+                        "so one torn/corrupt file costs an epoch, not the "
+                        "run.  1 = the pre-chain overwrite-in-place")
+    p.add_argument("--ckpt-every-dispatch", type=int, default=0,
+                   help="checkpoint every M dispatch chunks MID-epoch "
+                        "(device-cache path only; resumable bit-identically "
+                        "from the exact dispatch boundary).  0 (default) = "
+                        "checkpoint at evaluation epochs only")
     p.add_argument("--coordinator", default=None, help="host0 addr for multi-host")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
@@ -84,22 +106,33 @@ def main(argv=None):
         logger.warning("--only-eval requires --save (reference train.py:337)")
         raise SystemExit(1)
 
+    # SIGTERM/SIGUSR1 -> graceful preemption: checkpoint at the next
+    # safe boundary, exit 77 ("resume me" — docs/RESILIENCE.md)
+    install_signal_handlers()
     t0 = time.time()
-    result = train_and_eval(
-        conf,
-        args.dataroot,
-        test_ratio=args.cv_ratio,
-        cv_fold=args.cv,
-        save_path=args.save or None,
-        only_eval=args.only_eval,
-        evaluation_interval=args.evaluation_interval,
-        metric="last",
-        seed=args.seed,
-        aug_dispatch=args.aug_dispatch,
-        aug_groups=args.aug_groups,
-        device_cache=args.device_cache,
-        steps_per_dispatch=args.steps_per_dispatch,
-    )
+    try:
+        result = train_and_eval(
+            conf,
+            args.dataroot,
+            test_ratio=args.cv_ratio,
+            cv_fold=args.cv,
+            save_path=args.save or None,
+            only_eval=args.only_eval,
+            evaluation_interval=args.evaluation_interval,
+            metric="last",
+            seed=args.seed,
+            aug_dispatch=args.aug_dispatch,
+            aug_groups=args.aug_groups,
+            device_cache=args.device_cache,
+            steps_per_dispatch=args.steps_per_dispatch,
+            divergence_retries=args.divergence_retries,
+            ckpt_keep=args.ckpt_keep,
+            checkpoint_every_dispatch=args.ckpt_every_dispatch,
+        )
+    except PreemptedError as e:
+        logger.warning("preempted (%s) — exiting %d so the supervisor "
+                       "resumes this run", e, PREEMPTED_EXIT_CODE)
+        raise SystemExit(PREEMPTED_EXIT_CODE)
     elapsed = time.time() - t0
     logger.info("done %s: %s", args.tag, json.dumps(
         {k: round(v, 5) if isinstance(v, float) else v for k, v in result.items()}))

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fast_autoaugment_tpu.core.checkpoint import load_checkpoint, read_metadata
+from fast_autoaugment_tpu.core.resilience import PreemptedError
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.models import get_model, num_class
 from fast_autoaugment_tpu.ops.augment import SEARCH_OP_NAMES
@@ -192,10 +193,13 @@ def _fold_ckpt_path(save_dir: str, conf, fold: int, cv_ratio: float) -> str:
 
 
 # every per-checkpoint artifact train_and_eval emits: the msgpack, the
-# cheap-metadata sidecar, and the ScalarWriter logs — retry promotion
-# must move/remove all of them or the promoted fold keeps the rejected
-# run's training curves
-_CKPT_SUFFIXES = ("", ".meta.json", "_train.jsonl", "_valid.jsonl", "_test.jsonl")
+# cheap-metadata sidecar, the rollback-chain link (+ its sidecar —
+# default --ckpt-keep depth; a stale chain link from a REJECTED retry
+# must never survive as rollback material for the promoted fold), and
+# the ScalarWriter logs — retry promotion must move/remove all of them
+# or the promoted fold keeps the rejected run's training curves
+_CKPT_SUFFIXES = ("", ".meta.json", ".prev", ".prev.meta.json",
+                  "_train.jsonl", "_valid.jsonl", "_test.jsonl")
 
 
 def _replace_ckpt(src: str, dst: str):
@@ -456,6 +460,8 @@ def search_policies(
     aug_groups: int = 8,
     device_cache: str = "auto",
     steps_per_dispatch: int = 1,
+    divergence_retries: int = 0,
+    ckpt_keep: int = 2,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -535,6 +541,16 @@ def search_policies(
     ``search_result.json``.  Phase-1 pretraining is policy-free, so the
     knob does not touch it.
 
+    Resilience (docs/RESILIENCE.md): `divergence_retries` and
+    `ckpt_keep` thread into every phase-1/retry training run (rollback
+    chains + NaN-epoch replay); a phase-2 trial whose TTA evaluation
+    raises is QUARANTINED — told to the TPE as the worst observed
+    reward (the constant-liar value) and recorded with its failure in
+    the trial log and ``search_result.json['quarantined_trials']`` —
+    instead of killing the search.  A preemption request
+    (:class:`PreemptedError`) always propagates: per-fold checkpoints
+    and the per-trial log make the rerun resume where it stopped.
+
     PHASE ordering stays sequential (VERDICT round 1, next-step 9):
     phase-1 fold training and phase-2 TTA evaluation are both
     device-bound on the same chip, so overlapping PHASES cannot shorten
@@ -603,8 +619,19 @@ def search_policies(
     steps_per_dispatch = max(1, int(steps_per_dispatch))
     result["device_cache"] = device_cache
     result["steps_per_dispatch"] = steps_per_dispatch
+    divergence_retries = max(0, int(divergence_retries))
+    ckpt_keep = max(1, int(ckpt_keep))
+    result["resilience"] = {"divergence_retries": divergence_retries,
+                            "ckpt_keep": ckpt_keep}
+    # quarantined phase-2 trials (TTA evaluation raised): recorded, told
+    # to TPE as the worst observed reward, never ranked
+    quarantined: list[dict] = []
+    # shared by the sequential trainer AND the fold stack; the
+    # divergence-retry knob is sequential-only (train_and_eval)
     train_feed_kw = dict(device_cache=device_cache,
-                         steps_per_dispatch=steps_per_dispatch)
+                         steps_per_dispatch=steps_per_dispatch,
+                         ckpt_keep=ckpt_keep)
+    seq_train_kw = dict(train_feed_kw, divergence_retries=divergence_retries)
     fold_baselines: dict[int, float] = {}
     excluded_folds: list[int] = []
 
@@ -704,7 +731,7 @@ def search_policies(
                     no_aug_conf, dataroot,
                     test_ratio=cv_ratio, cv_fold=fold,
                     save_path=path, metric="last", seed=seed,
-                    **train_feed_kw,
+                    **seq_train_kw,
                 )
             phase1_attr[fold] += (time.time() - t_f) * mesh.size
         else:
@@ -740,7 +767,7 @@ def search_policies(
                 train_and_eval(
                     no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
                     save_path=alt, metric="last", seed=retry_seed,
-                    **train_feed_kw,
+                    **seq_train_kw,
                 )
             phase1_attr[fold] += (time.time() - t_r) * mesh.size
             alt_acc = evaluator.baseline(fold, alt)
@@ -799,25 +826,67 @@ def search_policies(
                   n_startup=min(20, max(5, num_search // 4)))
         key_fold = jax.random.PRNGKey(seed * 77 + fold)
         fold_trials = trials_log.get(str(fold), [])
-        for sample_dict, reward in fold_trials:  # resume previous trials
-            tpe.tell(sample_dict, reward)
+        for entry in fold_trials:  # resume previous trials (a third
+            # element marks a quarantined trial's failure record)
+            tpe.tell(entry[0], entry[1])
+
+        def _quarantine(trial_lo: int, trial_hi: int, exc: BaseException,
+                        fold=fold) -> float:
+            """Record failed trial(s) and return the pessimistic reward
+            told to the TPE — the worst observed value, mirroring the
+            constant-liar placeholder (search/tpe.py::ask)."""
+            worst = (min(r for _, r in tpe.observations)
+                     if tpe.observations else 0.0)
+            logger.warning(
+                "phase2 fold %d trial(s) %d-%d: TTA evaluation FAILED "
+                "(%s: %s) — QUARANTINED with worst-observed reward %.4f; "
+                "the search continues", fold, trial_lo, trial_hi - 1,
+                type(exc).__name__, exc, worst)
+            for t in range(trial_lo, trial_hi):
+                quarantined.append({
+                    "fold": fold, "trial": t,
+                    "error": f"{type(exc).__name__}: {exc}"})
+            return worst
+
+        fi = None
+
+        def _injected_trial_error(trial_idx: int):
+            nonlocal fi
+            from fast_autoaugment_tpu.utils import faultinject
+
+            fi = faultinject.active_plan()
+            if fi is not None and fi.trial_error_at(trial_idx):
+                raise RuntimeError(
+                    f"injected trial_error at trial {trial_idx}")
 
         while trial_batch <= 1 and len(tpe.observations) < num_search:
             trial_idx = len(tpe.observations)
             proposal = tpe.suggest()
             policies = policy_decoder(proposal, num_policy, num_op)
             policy_t = jnp.asarray(policy_to_tensor(policies))
-            metrics = evaluator.evaluate(
-                fold, params, batch_stats, policy_t,
-                jax.random.fold_in(key_fold, trial_idx),
-            )
-            if "tta_executables_first" not in result:
+            failure = None
+            try:
+                _injected_trial_error(trial_idx)
+                metrics = evaluator.evaluate(
+                    fold, params, batch_stats, policy_t,
+                    jax.random.fold_in(key_fold, trial_idx),
+                )
+                reward = metrics["top1_valid"]
+            except PreemptedError:
+                raise  # graceful shutdown is NOT a trial failure
+            except (ArithmeticError, RuntimeError, ValueError, OSError) as e:
+                reward = _quarantine(trial_idx, trial_idx + 1, e)
+                failure = {"quarantined": True,
+                           "error": f"{type(e).__name__}: {e}"}
+            if failure is None and "tta_executables_first" not in result:
                 # snapshot after the very first evaluation: the
                 # zero-recompile assertion is final == first
                 result["tta_executables_first"] = executable_census(
                     evaluator.tta_step)
-            tpe.tell(proposal, metrics["top1_valid"])
-            fold_trials.append((proposal, metrics["top1_valid"]))
+            tpe.tell(proposal, reward)
+            fold_trials.append(
+                (proposal, reward) if failure is None
+                else (proposal, reward, failure))
             # persist EVERY trial (fsync + atomic rename): a crash loses
             # at most the in-flight evaluation (VERDICT r3, weak 4); the
             # JSON is small and the write is trivially cheap next to a
@@ -827,7 +896,7 @@ def search_policies(
             if trial_idx % 10 == 0 or trial_idx == num_search - 1:
                 logger.info(
                     "phase2 fold %d trial %d/%d: top1_valid=%.4f best=%.4f",
-                    fold, trial_idx, num_search, metrics["top1_valid"], tpe.best[1],
+                    fold, trial_idx, num_search, reward, tpe.best[1],
                 )
 
         # trial-parallel scheduler (trial_batch = K > 1): ask K
@@ -855,15 +924,31 @@ def search_policies(
                 jax.random.fold_in(key_fold, t_base + i)
                 for i in range(trial_batch)
             ])
-            metrics_list = evaluator.evaluate_batch(
-                fold, params, batch_stats, policies_t, keys)[:k_eff]
-            if "tta_batched_executables_first" not in result:
+            round_failure = None
+            try:
+                for i in range(k_eff):
+                    _injected_trial_error(t_base + i)
+                metrics_list = evaluator.evaluate_batch(
+                    fold, params, batch_stats, policies_t, keys)[:k_eff]
+                rewards = [m["top1_valid"] for m in metrics_list]
+            except PreemptedError:
+                raise
+            except (ArithmeticError, RuntimeError, ValueError, OSError) as e:
+                # one vmapped program evaluates the whole round: a raise
+                # cannot be attributed to a single candidate, so the
+                # ROUND is quarantined (K x the sequential policy)
+                worst = _quarantine(t_base, t_base + k_eff, e)
+                rewards = [worst] * k_eff
+                round_failure = {"quarantined": True,
+                                 "error": f"{type(e).__name__}: {e}"}
+            if round_failure is None and \
+                    "tta_batched_executables_first" not in result:
                 result["tta_batched_executables_first"] = executable_census(
                     evaluator.tta_step_batch)
-            rewards = [m["top1_valid"] for m in metrics_list]
             tpe.tell_batch(proposals, rewards)
             fold_trials.extend(
-                (p, r) for p, r in zip(proposals, rewards))
+                (p, r) if round_failure is None else (p, r, round_failure)
+                for p, r in zip(proposals, rewards))
             trials_log[str(fold)] = fold_trials
             _write_json_atomic(trials_path, trials_log)
             logger.info(
@@ -891,12 +976,35 @@ def search_policies(
                 "final policy set", fold_key, len(fold_trials), num_search,
             )
             continue
-        ranked = sorted(fold_trials, key=lambda o: -o[1])[:num_top]
-        for proposal, _reward in ranked:
-            final_policy_set.extend(policy_decoder(proposal, num_policy, num_op))
+        # quarantined trials (3rd element = failure record) carry the
+        # worst-observed placeholder reward; they never rank — a failed
+        # evaluation must not nominate policies even in a tiny run
+        scored = [t for t in fold_trials
+                  if len(t) < 3 or not (t[2] or {}).get("quarantined")]
+        ranked = sorted(scored, key=lambda o: -o[1])[:num_top]
+        for entry in ranked:
+            final_policy_set.extend(
+                policy_decoder(entry[0], num_policy, num_op))
 
     final_policy_set = remove_duplicates(final_policy_set)
     result["num_sub_policies_selected"] = len(final_policy_set)
+    # canonical quarantine stamp from the PERSISTED trial log: covers
+    # trials failed in this process and ones resumed from disk alike
+    quarantined = [
+        {"fold": int(fk), "trial": i,
+         "error": (t[2] or {}).get("error", "unknown")}
+        for fk, trs in sorted(trials_log.items())
+        if fk.lstrip("-").isdigit()
+        for i, t in enumerate(trs)
+        if len(t) >= 3 and (t[2] or {}).get("quarantined")
+    ]
+    result["quarantined_trials"] = quarantined
+    result["num_quarantined_trials"] = len(quarantined)
+    if quarantined:
+        logger.warning(
+            "phase2: %d trial(s) quarantined after failed TTA "
+            "evaluations — see search_result.json['quarantined_trials']",
+            len(quarantined))
     result["device_secs_phase2"] = result["tpu_secs_phase2"] = (
         (time.time() - t0) * mesh.size)
     # compile-cache census: the whole point of policy-as-tensor TTA is

@@ -27,12 +27,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from fast_autoaugment_tpu.core.checkpoint import (
-    checkpoint_exists,
-    load_checkpoint,
+    load_checkpoint_chain,
     read_metadata,
     save_checkpoint,
 )
 from fast_autoaugment_tpu.core.metrics import Accumulator
+from fast_autoaugment_tpu.core.resilience import (
+    PREEMPTED_EXIT_CODE,
+    PreemptedError,
+    install_signal_handlers,
+    preemption_requested,
+)
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.data.pipeline import (
     BatchIterator,
@@ -69,6 +74,7 @@ from fast_autoaugment_tpu.train.steps import (
     slice_state,
     stack_states,
 )
+from fast_autoaugment_tpu.utils import faultinject
 from fast_autoaugment_tpu.utils.logging import get_logger, make_writers
 
 __all__ = ["train_and_eval", "train_folds_stacked", "resolve_policy_tensor"]
@@ -184,6 +190,9 @@ def train_and_eval(
     aug_groups: int = 8,
     device_cache: str = "auto",
     steps_per_dispatch: int = 1,
+    divergence_retries: int = 0,
+    ckpt_keep: int = 2,
+    checkpoint_every_dispatch: int = 0,
 ) -> dict:
     """Train (or just evaluate) one model under `conf`.
 
@@ -208,6 +217,20 @@ def train_and_eval(
     documented ~1 f32 ULP/step scan-kernel bound (the fold-stacking
     deviation class — docs/BENCHMARKS.md "Step dispatch & device
     cache").
+
+    Resilience (docs/RESILIENCE.md; defaults preserve the historical
+    behavior bit-for-bit): SIGTERM/SIGUSR1 requests a graceful stop —
+    the loop checkpoints at the next dispatch-chunk (cache path) or
+    epoch boundary with ``preempted: true`` metadata and raises
+    :class:`PreemptedError` (exit-code contract 77 = "resume me").
+    ``divergence_retries`` (R, default 0 = raise as before) rolls a
+    non-finite epoch loss back to the newest intact epoch-boundary
+    checkpoint up to R times, folding the retry counter into the PRNG
+    and shuffle seeds so the replay draws fresh randomness.
+    ``ckpt_keep`` bounds the rollback chain (``path``, ``path.prev``,
+    …).  ``checkpoint_every_dispatch`` (M, cache path only) adds a
+    mid-epoch snapshot every M dispatches — resumable from the exact
+    dispatch boundary, bit-identically.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -337,13 +360,52 @@ def train_and_eval(
         is_master,
     )
 
+    ckpt_keep = max(1, int(ckpt_keep))
+    divergence_retries = max(0, int(divergence_retries))
+    checkpoint_every_dispatch = max(0, int(checkpoint_every_dispatch))
+    # flag-setting SIGTERM/SIGUSR1 handlers (idempotent, main thread
+    # only): the epoch/dispatch loops below poll the flag at safe
+    # boundaries — see core/resilience.py and docs/RESILIENCE.md
+    install_signal_handlers()
+
     epoch_start = 1
-    if save_path and checkpoint_exists(save_path):
-        meta = read_metadata(save_path) or {}
+    resume_pos = 0          # mid-epoch fast-forward (preempted snapshot)
+    resume_sums: dict | None = None
+    retries_done = 0        # divergence-retry counter (folds the PRNG)
+    restored = None
+    if save_path:
         # lenient when the file came from the torch importer (no opt_state)
+        lenient = bool((read_metadata(save_path) or {}).get("imported_from"))
+        # restore from the NEWEST intact chain link; mid-epoch
+        # (preempted) snapshots are only restorable where the dispatch
+        # position can be fast-forwarded — the device-cache index feed.
+        # The host path walks back to an epoch-boundary link instead.
+        restored = load_checkpoint_chain(
+            save_path, state, lenient=lenient, keep=ckpt_keep,
+            accept=None if use_cache else (lambda m: "in_epoch" not in m))
+        if restored is not None and "in_epoch" in restored[1]:
+            rec = restored[1]["in_epoch"] or {}
+            if int(rec.get("epoch", -1)) != int(restored[1].get("epoch", 0)) + 1:
+                logger.warning(
+                    "inconsistent mid-epoch record in %s — falling back "
+                    "to an epoch-boundary chain link", restored[2])
+                restored = load_checkpoint_chain(
+                    save_path, state, lenient=lenient, keep=ckpt_keep,
+                    accept=lambda m: "in_epoch" not in m)
+    if restored is not None:
+        state, meta, used_path = restored
         lenient = bool(meta.get("imported_from"))
-        state = load_checkpoint(save_path, state, lenient=lenient)
         epoch_start = int(meta.get("epoch", 0)) + 1
+        in_epoch = meta.get("in_epoch")
+        if in_epoch:
+            resume_pos = int(in_epoch["pos"])
+            resume_sums = {k: np.float32(v)
+                           for k, v in (in_epoch.get("sums") or {}).items()}
+            retries_done = int(in_epoch.get("retries", 0))
+            logger.info(
+                "resuming MID-EPOCH: epoch %d from dispatch position %d "
+                "(preempted snapshot %s)", epoch_start, resume_pos,
+                used_path)
         if lenient:
             fixes = {}
             # the schedule is a pure fn of step: place it at the resume
@@ -357,7 +419,7 @@ def train_and_eval(
                     {"params": state.params, "batch_stats": state.batch_stats},
                 )
             state = state.replace(**fixes)
-        logger.info("resumed %s at epoch %d", save_path, epoch_start - 1)
+        logger.info("resumed %s at epoch %d", used_path, epoch_start - 1)
         if epoch_start > epochs:
             only_eval = True
     elif only_eval and save_path:
@@ -456,7 +518,21 @@ def train_and_eval(
     pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
     if train_cache is not None:
         pol = jax.device_put(pol, replicated(mesh))
-    for epoch in range(epoch_start, epochs + 1):
+    # while (not for): divergence recovery rolls `epoch` BACK to the
+    # last good checkpoint's successor and replays with fresh randomness
+    epoch = epoch_start
+    while epoch <= epochs:
+        fi = faultinject.active_plan()
+        # divergence-retry randomness: after any rollback every epoch
+        # draws retry-folded augmentation keys and shuffle seeds;
+        # retries_done == 0 is bit-for-bit the historical stream
+        if retries_done:
+            rng_epoch = jax.random.fold_in(rng, 1_000_003 * retries_done)
+            seed_epoch = seed + 1_000_003 * retries_done
+            if train_cache is not None:
+                rng_epoch = jax.device_put(rng_epoch, replicated(mesh))
+        else:
+            rng_epoch, seed_epoch = rng, seed
         acc = Accumulator()
         # live per-batch progress (the reference's tqdm postfix,
         # train.py:79-88): FAA_PROGRESS=N prints a loss-EMA line every N
@@ -466,7 +542,11 @@ def train_and_eval(
         # touches metric values mid-epoch.
         try:
             progress_every = int(os.environ.get("FAA_PROGRESS", "0") or 0)
-        except ValueError:  # cosmetic knob must never kill a run
+        except ValueError:  # cosmetic knob must never kill a run — but
+            # the misconfiguration must be VISIBLE, not silently eaten
+            logger.warning(
+                "FAA_PROGRESS=%r is not an integer — live progress "
+                "line disabled", os.environ.get("FAA_PROGRESS"))
             progress_every = 0
         loss_ema = None
 
@@ -484,18 +564,27 @@ def train_and_eval(
             # IDENTICAL host permutation; only the index matrix is
             # shipped, and each dispatch advances a whole scan chunk
             mat = train_index_matrix(
-                train_idx, global_batch, epoch, seed=seed,
+                train_idx, global_batch, epoch, seed=seed_epoch,
                 process_index=jax.process_index(),
                 process_count=jax.process_count(),
             )
             pos = 0
             dispatch_metrics: list = []
+            if resume_pos and epoch == epoch_start:
+                # preempted mid-epoch: skip the dispatches already done
+                # and seed the metric chain with the saved partial sums
+                # — the host additions below continue the SAME
+                # sequential f32 chain, so the epoch's reported metrics
+                # are bit-identical to the uninterrupted run
+                pos = resume_pos
+                if resume_sums:
+                    dispatch_metrics.append(dict(resume_sums))
             for di, n in enumerate(split_dispatch_chunks(
-                    len(mat), steps_per_dispatch)):
+                    len(mat) - pos, steps_per_dispatch)):
                 idx_dev = place_index_matrix(mesh, mat[pos:pos + n])
                 state, metrics = get_multi_step(n)(
                     state, train_cache.images, train_cache.labels,
-                    idx_dev, pol, rng)
+                    idx_dev, pol, rng_epoch)
                 # per-dispatch sums are kept as ASYNC device handles and
                 # summed on host at epoch end (_sum_metric_dicts): with
                 # the committed state a per-dispatch jnp add would queue
@@ -504,20 +593,58 @@ def train_and_eval(
                 dispatch_metrics.append(metrics)
                 progress(di, metrics)
                 pos += n
+                if fi is not None:
+                    fi.maybe_signal((epoch - 1) * steps_per_epoch + pos)
+                # resilience boundary: the PR-4 dispatch boundaries are
+                # exact resume points — honor a preemption request (or
+                # the periodic snapshot knob) here, mid-epoch
+                periodic = (checkpoint_every_dispatch > 0
+                            and (di + 1) % checkpoint_every_dispatch == 0)
+                if pos < len(mat) and (preemption_requested() or periodic):
+                    if save_path and is_master:
+                        sums = _sum_metric_dicts(dispatch_metrics)
+                        save_checkpoint(
+                            save_path, state,
+                            {"epoch": epoch - 1,
+                             "step": (epoch - 1) * steps_per_epoch + pos,
+                             "preempted": preemption_requested(),
+                             "in_epoch": {
+                                 "epoch": epoch, "pos": pos,
+                                 "sums": {k: float(v)
+                                          for k, v in sums.items()},
+                                 "retries": retries_done}},
+                            keep=ckpt_keep)
+                        # saved sums replace the pending handles — the
+                        # continued f32 chain is identical either way
+                        dispatch_metrics = [
+                            {k: np.float32(v) for k, v in sums.items()}]
+                    if preemption_requested():
+                        logger.warning(
+                            "preempted at epoch %d dispatch boundary "
+                            "(position %d/%d) — checkpointed, exit %d "
+                            "means 'resume me'", epoch, pos, len(mat),
+                            PREEMPTED_EXIT_CODE)
+                        raise PreemptedError(
+                            f"preempted mid-epoch {epoch} at dispatch "
+                            f"position {pos}")
             acc.add_dict(_sum_metric_dicts(dispatch_metrics))
         else:
             batches = prefetch(
                 train_it.train_epoch(
-                    global_batch, epoch, seed=seed,
+                    global_batch, epoch, seed=seed_epoch,
                     process_index=jax.process_index(),
                     process_count=jax.process_count(),
                 ),
                 transform=shard_transform(mesh),
             )
             for bi, batch in enumerate(batches):
-                state, metrics = train_step(state, batch["x"], batch["y"], pol, rng)
+                state, metrics = train_step(state, batch["x"], batch["y"],
+                                            pol, rng_epoch)
                 acc.add_dict(metrics)
                 progress(bi, metrics)
+                if fi is not None:
+                    fi.maybe_signal((epoch - 1) * steps_per_epoch + bi + 1)
+        resume_pos, resume_sums = 0, None  # consumed by the first epoch
         if is_master and progress_every and loss_ema is not None:
             sys.stderr.write("\n")
         train_metrics = acc.normalize()
@@ -527,7 +654,35 @@ def train_and_eval(
                 f"({len(train_idx)} examples, global batch {global_batch}) — "
                 "feed pipeline bug or dataset/batch mismatch"
             )
-        if np.isnan(train_metrics["loss"]):
+        if fi is not None and fi.nan_loss_in((epoch - 1) * steps_per_epoch,
+                                             epoch * steps_per_epoch):
+            train_metrics["loss"] = float("nan")  # injected at the seam
+        if not np.isfinite(train_metrics["loss"]):
+            # divergence recovery (--divergence-retries R, default 0 =
+            # the historical raise): roll back to the newest intact
+            # EPOCH-BOUNDARY chain link and replay with retry-folded
+            # randomness; re-raise only after R failed rollbacks
+            if retries_done < divergence_retries and save_path:
+                rolled = load_checkpoint_chain(
+                    save_path, state, keep=ckpt_keep,
+                    accept=lambda m: "in_epoch" not in m)
+                if rolled is not None:
+                    retries_done += 1
+                    state, meta_rb, used_rb = rolled
+                    if train_cache is not None:
+                        state = jax.device_put(state, replicated(mesh))
+                    rollback_epoch = int(meta_rb.get("epoch", 0)) + 1
+                    logger.warning(
+                        "divergence: non-finite loss at epoch %d — rolled "
+                        "back to %s (replaying from epoch %d), retry %d/%d "
+                        "with retry-folded PRNG/shuffle streams",
+                        epoch, used_rb, rollback_epoch, retries_done,
+                        divergence_retries)
+                    epoch = rollback_epoch
+                    continue
+                logger.error(
+                    "divergence: retries remain but NO intact rollback "
+                    "checkpoint under %s — re-raising", save_path)
             raise RuntimeError("loss is NaN — training diverged (reference train.py:259)")
 
         # periodic EMA -> model weight restore (reference train.py:262-270)
@@ -587,6 +742,7 @@ def train_and_eval(
                             "metrics": {k: float(v) for k, v in result.items()
                                         if isinstance(v, (int, float))},
                         },
+                        keep=ckpt_keep,
                     )
             if reporter is not None:
                 reporter(
@@ -596,6 +752,25 @@ def train_and_eval(
                     top1_train=train_metrics["top1"],
                     epoch=epoch,
                 )
+
+        # graceful preemption at the epoch boundary (the host path's
+        # only safe point; the cache path usually caught the flag at a
+        # dispatch boundary already): checkpoint the COMPLETED epoch
+        # with preempted metadata and exit via the 77 contract
+        if preemption_requested():
+            if save_path and is_master:
+                save_checkpoint(
+                    save_path, state,
+                    {"epoch": epoch, "step": int(state.step),
+                     "preempted": True,
+                     "metrics": {k: float(v) for k, v in result.items()
+                                 if isinstance(v, (int, float))}},
+                    keep=ckpt_keep)
+            logger.warning(
+                "preempted at epoch %d boundary — checkpointed, exit %d "
+                "means 'resume me'", epoch, PREEMPTED_EXIT_CODE)
+            raise PreemptedError(f"preempted after epoch {epoch}")
+        epoch += 1
 
     result["elapsed_sec"] = time.time() - t_start
     for w in writers:
@@ -619,6 +794,7 @@ def train_folds_stacked(
     aug_groups: int = 8,
     device_cache: str = "auto",
     steps_per_dispatch: int = 1,
+    ckpt_keep: int = 2,
 ) -> dict[int, dict]:
     """Train K phase-1 fold models as ONE vmapped program per step.
 
@@ -662,6 +838,14 @@ def train_folds_stacked(
     advances K folds x N steps (the scan sits outside the fold vmap —
     ``make_multistep_train_step``).  The dataset here is always eager
     (checked above), so "auto" enables the cache on single-process runs.
+
+    Resilience (docs/RESILIENCE.md): a SIGTERM/SIGUSR1 preemption
+    request is honored at the next dispatch-chunk boundary (cache path
+    — every active fold checkpoints its slice with ``preempted: true``
+    + the mid-epoch position, resumable bit-identically) or epoch
+    boundary (host path), then :class:`PreemptedError` carries the
+    exit-77 contract up.  ``ckpt_keep`` bounds each fold's rollback
+    chain; restore walks to the newest intact link.
     """
     if len(folds) != len(save_paths):
         raise ValueError(f"{len(folds)} folds but {len(save_paths)} paths")
@@ -761,22 +945,78 @@ def train_folds_stacked(
         lb_smooth=float(conf.get("lb_smooth", 0.0) or 0.0),
     ) if use_cache else None
 
-    # per-fold init/restore, then one stacked state
-    states, epoch_starts = [], []
+    ckpt_keep = max(1, int(ckpt_keep))
+    install_signal_handlers()
+
+    # per-fold init/restore (newest intact chain link), then one
+    # stacked state
+    states, epoch_starts, fold_metas = [], [], []
     for k, (fold, path) in enumerate(zip(folds, save_paths)):
         state = create_train_state(
             model, optimizer, jax.random.PRNGKey(seeds[k]), sample,
             use_ema=ema_mu > 0.0,
         )
-        epoch_start = 1
-        if resume and path and checkpoint_exists(path):
-            meta = read_metadata(path) or {}
-            state = load_checkpoint(path, state)
-            epoch_start = int(meta.get("epoch", 0)) + 1
-            logger.info("stacked: resumed fold %d at epoch %d", fold,
-                        epoch_start - 1)
+        epoch_start, meta = 1, {}
+        if resume and path:
+            got = load_checkpoint_chain(path, state, keep=ckpt_keep)
+            if got is not None:
+                state, meta, used = got
+                epoch_start = int(meta.get("epoch", 0)) + 1
+                logger.info(
+                    "stacked: resumed fold %d at epoch %d%s", fold,
+                    epoch_start - 1,
+                    " (mid-epoch snapshot)" if "in_epoch" in meta else "")
         states.append(state)
         epoch_starts.append(epoch_start)
+        fold_metas.append(meta)
+
+    # mid-epoch (preempted) snapshots fast-forward the stacked dispatch
+    # loop only when EVERY restored record agrees on (epoch, pos) and
+    # the device-cache index feed is active (positions can be skipped);
+    # otherwise each mid-epoch fold falls back to its epoch-boundary
+    # chain link — losing at most the interrupted epoch, never
+    # silently double-training it
+    in_epoch_recs = [m.get("in_epoch") for m in fold_metas]
+    stk_resume_pos, stk_resume_epoch, stk_resume_sums = 0, -1, None
+    if any(in_epoch_recs):
+        ref = next(r for r in in_epoch_recs if r)
+        agree = use_cache and all(
+            (r is not None and r.get("epoch") == ref["epoch"]
+             and r.get("pos") == ref["pos"])
+            or (r is None and epoch_starts[k] > int(ref["epoch"]))
+            for k, r in enumerate(in_epoch_recs))
+        if agree:
+            stk_resume_pos = int(ref["pos"])
+            stk_resume_epoch = int(ref["epoch"])
+            sum_keys = sorted({kk for r in in_epoch_recs if r
+                               for kk in (r.get("sums") or {})})
+            stk_resume_sums = {
+                kk: np.asarray(
+                    [(r.get("sums") or {}).get(kk, 0.0) if r else 0.0
+                     for r in in_epoch_recs], np.float32)
+                for kk in sum_keys}
+            logger.info(
+                "stacked: resuming MID-EPOCH at epoch %d, dispatch "
+                "position %d", stk_resume_epoch, stk_resume_pos)
+        else:
+            for k, r in enumerate(in_epoch_recs):
+                if r is None:
+                    continue
+                logger.warning(
+                    "stacked: fold %d mid-epoch snapshot unusable here "
+                    "(position disagreement or host feed) — falling back "
+                    "to its epoch-boundary chain link", folds[k])
+                got = load_checkpoint_chain(
+                    save_paths[k], states[k], keep=ckpt_keep,
+                    accept=lambda m: "in_epoch" not in m)
+                if got is not None:
+                    states[k], meta_k, _used = got
+                    epoch_starts[k] = int(meta_k.get("epoch", 0)) + 1
+                else:
+                    states[k] = create_train_state(
+                        model, optimizer, jax.random.PRNGKey(seeds[k]),
+                        sample, use_ema=ema_mu > 0.0)
+                    epoch_starts[k] = 1
     stacked = stack_states(states)
     del states
     # shard every state leaf's leading fold axis over the mesh fold
@@ -843,10 +1083,22 @@ def train_folds_stacked(
     first_epoch = min(epoch_starts)
     transform = stacked_shard_transform(mesh)
     for epoch in range(first_epoch, epochs + 1):
+        fi = faultinject.active_plan()
         epoch_active = np.asarray(
             [1.0 if epoch >= epoch_starts[k] else 0.0
              for k in range(num_folds)], np.float32)
         ep_act_dev = jnp.asarray(epoch_active)
+
+        def _save_fold_slices(meta_fn):
+            """Checkpoint every active fold's slice (master only)."""
+            if not is_master:
+                return
+            for k2 in range(num_folds):
+                if not epoch_active[k2] or not save_paths[k2]:
+                    continue
+                save_checkpoint(save_paths[k2], slice_state(stacked, k2),
+                                meta_fn(k2), keep=ckpt_keep)
+
         # per-fold sums stay DEVICE-side [K] vectors until epoch end —
         # reading them per batch would sync the dispatch pipeline (the
         # same discipline as the sequential epoch loop)
@@ -860,7 +1112,15 @@ def train_folds_stacked(
             act = act * epoch_active[None, :]
             pos = 0
             dispatch_metrics: list = []
-            for n in split_dispatch_chunks(len(chunks), steps_per_dispatch):
+            if stk_resume_pos and epoch == stk_resume_epoch:
+                # preempted mid-epoch: skip the completed dispatches and
+                # seed the per-fold f32 sum chain (bit-identical
+                # continuation, as in the sequential trainer)
+                pos = stk_resume_pos
+                if stk_resume_sums:
+                    dispatch_metrics.append(dict(stk_resume_sums))
+            for n in split_dispatch_chunks(len(chunks) - pos,
+                                           steps_per_dispatch):
                 idx_dev, act_dev = place_stacked_index_matrix(
                     mesh, chunks[pos:pos + n], act[pos:pos + n])
                 stacked, metrics = get_multi_step(n)(
@@ -872,6 +1132,30 @@ def train_folds_stacked(
                 # CPU backend (_sum_metric_dicts / make_replay_eval_step)
                 dispatch_metrics.append(metrics)
                 pos += n
+                if fi is not None:
+                    fi.maybe_signal((epoch - 1) * steps_per_epoch + pos)
+                if preemption_requested() and pos < len(chunks):
+                    # dispatch-boundary preemption: every active fold
+                    # checkpoints its slice with the shared mid-epoch
+                    # position, then the 77 contract goes up
+                    sums = _sum_metric_dicts(dispatch_metrics)
+                    _save_fold_slices(lambda k2: {
+                        "epoch": epoch - 1,
+                        "step": (epoch - 1) * steps_per_epoch + pos,
+                        "preempted": True,
+                        "in_epoch": {
+                            "epoch": epoch, "pos": pos,
+                            "sums": {kk: float(np.asarray(v)[k2])
+                                     for kk, v in sums.items()}}})
+                    logger.warning(
+                        "stacked: preempted at epoch %d dispatch boundary "
+                        "(position %d/%d) — %d fold slice(s) checkpointed, "
+                        "exit %d means 'resume me'", epoch, pos,
+                        len(chunks), int(epoch_active.sum()),
+                        PREEMPTED_EXIT_CODE)
+                    raise PreemptedError(
+                        f"stacked preempted mid-epoch {epoch} at dispatch "
+                        f"position {pos}")
             if dispatch_metrics:
                 epoch_sums = _sum_metric_dicts(dispatch_metrics)
         else:
@@ -884,12 +1168,14 @@ def train_folds_stacked(
                 ),
                 transform=transform,
             )
-            for batch in batches:
+            for bi, batch in enumerate(batches):
                 active = batch["a"] * ep_act_dev
                 stacked, metrics = stacked_step(
                     stacked, batch["x"], batch["y"], pol, keys, active)
                 epoch_sums = metrics if epoch_sums is None else {
                     kk: epoch_sums[kk] + metrics[kk] for kk in epoch_sums}
+                if fi is not None:
+                    fi.maybe_signal((epoch - 1) * steps_per_epoch + bi + 1)
         host_sums = {kk: np.asarray(v)
                      for kk, v in (epoch_sums or {}).items()}
 
@@ -949,7 +1235,20 @@ def train_folds_stacked(
                                         for kk, v in results[fold].items()
                                         if isinstance(v, (int, float))},
                         },
+                        keep=ckpt_keep,
                     )
+
+        # epoch-boundary preemption (the host path's only safe point):
+        # checkpoint every active fold's COMPLETED epoch, exit via 77
+        if preemption_requested():
+            _save_fold_slices(lambda k2: {
+                "epoch": epoch,
+                "step": int(slice_state(stacked, k2).step),
+                "preempted": True})
+            logger.warning(
+                "stacked: preempted at epoch %d boundary — checkpointed, "
+                "exit %d means 'resume me'", epoch, PREEMPTED_EXIT_CODE)
+            raise PreemptedError(f"stacked preempted after epoch {epoch}")
 
     elapsed = time.time() - t_start
     for k, fold in enumerate(folds):

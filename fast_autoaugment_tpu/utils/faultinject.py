@@ -1,0 +1,216 @@
+"""Deterministic fault injection: ``FAA_FAULT`` -> seeded trigger points.
+
+Every recovery path in the resilience subsystem (docs/RESILIENCE.md) is
+driven by TESTS through this module rather than trusted on faith: the
+``FAA_FAULT`` environment variable names exact, reproducible failure
+points, and the production seams (loss readout, checkpoint write, host
+I/O, the phase-2 trial loop) consult it.  With ``FAA_FAULT`` unset every
+consult is a no-op behind one cached ``None`` check.
+
+Grammar — semicolon-separated specs, each ``kind@key=value[,key=value]``::
+
+    FAA_FAULT="nan_loss@step=7;sigterm@step=12;torn_ckpt@save=3;io_error@p=0.1,seed=0"
+
+Kinds and their trigger coordinates:
+
+``nan_loss@step=K``
+    The epoch whose step range covers global step K reads back a NaN
+    train loss (consulted at the trainer's loss readout).
+``sigterm@step=K`` / ``sigusr1@step=K`` / ``sigkill@step=K``
+    The named signal is delivered to THIS process once global step >= K
+    (consulted after every dispatch/batch).  sigterm/sigusr1 exercise
+    the graceful preemption path; sigkill is the unannounced-death case
+    for the resume-under-fire e2e test.
+``torn_ckpt@save=N``
+    The N-th ``save_checkpoint`` call (1-based, counted while the plan
+    is active) writes a truncated payload while the sidecar digest
+    describes the full one — the torn-write crash the restore chain
+    must walk past.
+``corrupt_ckpt@save=N``
+    The N-th save flips payload bytes after the digest was computed —
+    silent bit-rot; restore must detect the mismatch.
+``io_error@p=P,seed=S``
+    Checkpoint payload/metadata READS raise OSError with probability P
+    from the seeded stream S (deterministic given call order).
+``trial_error@trial=K``
+    The phase-2 search raises at trial index K (per fold) — drives the
+    quarantine path.
+
+Each step/save/trial-pinned spec fires exactly ONCE per process (the
+counter-based kinds are consumed when hit); ``io_error`` fires per its
+Bernoulli stream.  Tests in the same process call :func:`reset` after
+mutating ``os.environ['FAA_FAULT']``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = ["FaultPlan", "active_plan", "reset", "parse_fault_spec"]
+
+logger = get_logger("faa_tpu.faultinject")
+
+ENV_VAR = "FAA_FAULT"
+
+_KINDS = {
+    "nan_loss": ("step",),
+    "sigterm": ("step",),
+    "sigusr1": ("step",),
+    "sigkill": ("step",),
+    "torn_ckpt": ("save",),
+    "corrupt_ckpt": ("save",),
+    "io_error": ("p", "seed"),
+    "trial_error": ("trial",),
+}
+
+
+def parse_fault_spec(spec: str) -> list[dict]:
+    """Parse the ``FAA_FAULT`` grammar into a list of fault dicts.
+
+    Raises ValueError on unknown kinds, missing/unknown keys or
+    malformed values — a typo in a fault spec must fail loudly, never
+    silently inject nothing.
+    """
+    faults = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" not in part:
+            raise ValueError(
+                f"bad fault spec {part!r}: expected kind@key=value[,key=value]")
+        kind, _, argstr = part.partition("@")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}: known {sorted(_KINDS)}")
+        args: dict = {}
+        for kv in argstr.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(f"bad fault arg {kv!r} in {part!r}")
+            key, _, val = kv.partition("=")
+            key = key.strip()
+            if key not in _KINDS[kind]:
+                raise ValueError(
+                    f"fault {kind!r} takes keys {_KINDS[kind]}, got {key!r}")
+            args[key] = float(val) if key == "p" else int(val)
+        required = {"io_error": ("p",)}.get(kind, _KINDS[kind])
+        missing = [k for k in required if k not in args]
+        if missing:
+            raise ValueError(f"fault {kind!r} missing keys {missing}")
+        if kind == "io_error":
+            args.setdefault("seed", 0)
+            if not 0.0 <= args["p"] <= 1.0:
+                raise ValueError(f"io_error p={args['p']} outside [0, 1]")
+        faults.append({"kind": kind, **args, "fired": False})
+    return faults
+
+
+class FaultPlan:
+    """The parsed plan plus per-kind trigger state."""
+
+    def __init__(self, faults: list[dict]):
+        self.faults = faults
+        self.save_count = 0  # process-wide save_checkpoint counter
+        self._io_rng = None
+        for f in faults:
+            if f["kind"] == "io_error":
+                self._io_rng = random.Random(int(f["seed"]))
+                self._io_p = float(f["p"])
+
+    # -- counter-pinned kinds -----------------------------------------
+    def _take(self, kind: str, key: str, value: int,
+              at_least: bool = False) -> dict | None:
+        """Consume-once match of a pinned spec against a coordinate."""
+        for f in self.faults:
+            if f["kind"] != kind or f["fired"]:
+                continue
+            hit = value >= f[key] if at_least else value == f[key]
+            if hit:
+                f["fired"] = True
+                logger.warning("faultinject: firing %s@%s=%d (at %s=%d)",
+                               kind, key, f[key], key, value)
+                return f
+        return None
+
+    def nan_loss_in(self, step_lo: int, step_hi: int) -> bool:
+        """True when a nan_loss spec's step falls in [step_lo, step_hi)
+        — the step range the just-finished epoch covered."""
+        for f in self.faults:
+            if f["kind"] == "nan_loss" and not f["fired"] \
+                    and step_lo <= f["step"] < step_hi:
+                f["fired"] = True
+                logger.warning(
+                    "faultinject: injecting NaN loss (step %d in epoch "
+                    "range [%d, %d))", f["step"], step_lo, step_hi)
+                return True
+        return False
+
+    def maybe_signal(self, step: int) -> None:
+        """Deliver any pending sig* spec whose step has been reached
+        (consulted after each dispatch/batch)."""
+        import signal as _signal
+
+        for kind, signum in (("sigterm", _signal.SIGTERM),
+                             ("sigusr1", _signal.SIGUSR1),
+                             ("sigkill", _signal.SIGKILL)):
+            if self._take(kind, "step", step, at_least=True):
+                os.kill(os.getpid(), signum)
+
+    def next_save(self) -> int:
+        self.save_count += 1
+        return self.save_count
+
+    def torn_at(self, save_n: int) -> bool:
+        return self._take("torn_ckpt", "save", save_n) is not None
+
+    def corrupt_at(self, save_n: int) -> bool:
+        return self._take("corrupt_ckpt", "save", save_n) is not None
+
+    def trial_error_at(self, trial: int) -> bool:
+        return self._take("trial_error", "trial", trial) is not None
+
+    def io_error_now(self) -> bool:
+        """Seeded Bernoulli draw per consult (checkpoint/metadata reads)."""
+        if self._io_rng is None:
+            return False
+        hit = self._io_rng.random() < self._io_p
+        if hit:
+            logger.warning("faultinject: injecting io_error (p=%.3f)",
+                           self._io_p)
+        return hit
+
+
+_plan: FaultPlan | None = None
+_plan_env: str | None = None
+
+
+def active_plan() -> FaultPlan | None:
+    """The process-wide plan, or None when ``FAA_FAULT`` is unset/empty.
+
+    Parsed once and cached; the cache is invalidated automatically when
+    the env var's VALUE changes (tests flip it between cases), but
+    trigger state within one value is preserved across consults.
+    """
+    global _plan, _plan_env
+    env = os.environ.get(ENV_VAR, "")
+    if env != _plan_env:
+        _plan_env = env
+        _plan = FaultPlan(parse_fault_spec(env)) if env.strip() else None
+        if _plan is not None:
+            logger.warning("faultinject: ACTIVE with %d fault(s): %s",
+                           len(_plan.faults), env)
+    return _plan
+
+
+def reset() -> None:
+    """Forget the cached plan and all trigger state (test isolation)."""
+    global _plan, _plan_env
+    _plan = None
+    _plan_env = None

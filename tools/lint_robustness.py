@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""AST robustness lint for the resilience contract (docs/RESILIENCE.md).
+
+Three rules, each a failure-handling discipline the resilience
+subsystem depends on:
+
+R1  **no bare ``except:``** anywhere in the package — a bare except
+    swallows KeyboardInterrupt/SystemExit and (worse here) the typed
+    PreemptedError/CheckpointCorruptError signals the recovery paths
+    route on.
+
+R2  **no swallowed broad excepts**: an ``except Exception`` /
+    ``except BaseException`` handler must log (``logger.*``,
+    ``logging.*``, ``warnings.warn``) or re-``raise`` — silently eating
+    unknown failures is how a production stack loses its only evidence.
+
+R3  **no direct run-artifact writes**: inside the run-artifact layers
+    (``core/``, ``search/``, ``train/``, ``launch/``), ``json.dump``
+    and write-mode ``open(...)`` are reserved to the atomic helpers
+    (``write_json_atomic``, ``save_checkpoint``) — a bare write torn by
+    a crash is exactly the corruption the restore chain exists to
+    survive.  Append-mode logs and reads are fine.
+
+Suppress a finding (sparingly, with a reason nearby) by putting
+``robust: allow`` in a comment on the offending line.
+
+Exit status: 0 clean, 1 findings (printed one per line,
+``path:line: rule message``).  Wired as ``make lint-robust`` and run in
+``make test-t1``'s preamble.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "fast_autoaugment_tpu"
+
+# R3 scope: the layers that write run artifacts (checkpoints, trial
+# logs, result JSONs).  utils/ (ScalarWriter's append-mode JSONL,
+# tb_events' event files) and data/ (dataset downloads) are excluded —
+# their files are streams/caches, not resumable run state.
+ARTIFACT_DIRS = ("core", "search", "train", "launch")
+
+# (relative module path suffix, function name) pairs allowed to write
+# directly: THE atomic helpers themselves.
+ARTIFACT_WRITERS = {
+    ("core/checkpoint.py", "save_checkpoint"),
+    ("search/driver.py", "write_json_atomic"),
+}
+
+_LOG_NAMES = {"logger", "logging", "log", "warnings"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "fatal"}
+
+
+class Finding:
+    def __init__(self, path: str, line: int, rule: str, msg: str):
+        self.path, self.line, self.rule, self.msg = path, line, rule, msg
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: {self.rule} {self.msg}"
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handles_failure(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body logs, re-raises, or captures the
+    bound exception value (``except ... as e: err.append(e)`` — the
+    propagate-through-a-channel pattern); swallowed means the failure
+    is DISCARDED with no evidence."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name \
+                and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                base = f.value
+                if isinstance(base, ast.Name) and (
+                        base.id in _LOG_NAMES
+                        or base.id.startswith("log")) \
+                        and f.attr in _LOG_METHODS | {"warn"}:
+                    return True
+                if isinstance(base, ast.Name) and base.id == "warnings" \
+                        and f.attr == "warn":
+                    return True
+    return False
+
+
+def _write_mode(call: ast.Call) -> str | None:
+    """The mode string of an ``open`` call if it writes, else None."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            mode = kw.value.value
+    if mode and ("w" in mode or "x" in mode or "+" in mode):
+        return mode
+    return None
+
+
+def check_source(src: str, relpath: str,
+                 artifact_scope: bool | None = None) -> list[Finding]:
+    """Lint one file's source.  `artifact_scope` forces R3 on/off
+    (None = derive from `relpath`)."""
+    findings: list[Finding] = []
+    lines = src.splitlines()
+
+    def allowed(lineno: int) -> bool:
+        return 0 < lineno <= len(lines) and "robust: allow" in lines[lineno - 1]
+
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(relpath, e.lineno or 0, "R0", f"syntax error: {e.msg}")]
+
+    if artifact_scope is None:
+        norm = relpath.replace(os.sep, "/")
+        artifact_scope = any(
+            f"/{d}/" in f"/{norm}" or norm.startswith(f"{d}/")
+            for d in (f"{PACKAGE}/{a}" for a in ARTIFACT_DIRS))
+
+    # enclosing-function map for the R3 allowlist
+    func_of: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.walk(node):
+                if hasattr(child, "lineno"):
+                    func_of.setdefault(child.lineno, node.name)
+
+    norm = relpath.replace(os.sep, "/")
+
+    def is_allowlisted_writer(lineno: int) -> bool:
+        fn = func_of.get(lineno, "")
+        return any(norm.endswith(suffix) and fn == name
+                   for suffix, name in ARTIFACT_WRITERS)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            if allowed(node.lineno):
+                continue
+            if node.type is None:
+                findings.append(Finding(
+                    relpath, node.lineno, "R1",
+                    "bare `except:` swallows SystemExit/KeyboardInterrupt "
+                    "and the typed resilience signals — name the "
+                    "exceptions"))
+            elif _is_broad(node) and not _handles_failure(node):
+                findings.append(Finding(
+                    relpath, node.lineno, "R2",
+                    "broad `except Exception` neither logs nor re-raises "
+                    "— a swallowed failure leaves no evidence"))
+        elif artifact_scope and isinstance(node, ast.Call):
+            if allowed(node.lineno) or is_allowlisted_writer(node.lineno):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "dump" \
+                    and isinstance(f.value, ast.Name) and f.value.id == "json":
+                findings.append(Finding(
+                    relpath, node.lineno, "R3",
+                    "direct json.dump to a run artifact — use "
+                    "write_json_atomic (fsync + rename) so a crash "
+                    "cannot tear the file"))
+            elif isinstance(f, ast.Name) and f.id == "open":
+                mode = _write_mode(node)
+                if mode:
+                    findings.append(Finding(
+                        relpath, node.lineno, "R3",
+                        f"direct open(..., {mode!r}) write to a run "
+                        "artifact — route through write_json_atomic / "
+                        "save_checkpoint"))
+    return findings
+
+
+def lint_tree(root: str = REPO) -> list[Finding]:
+    findings: list[Finding] = []
+    pkg_root = os.path.join(root, PACKAGE)
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        if "__pycache__" in dirpath:
+            continue
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            with open(path) as fh:
+                findings.extend(check_source(fh.read(), rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    findings = lint_tree()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint-robust: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("lint-robust: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
