@@ -115,6 +115,33 @@ def refuse_or_flag_contention(stamp: dict) -> dict:
     return stamp
 
 
+def watchdog_stamp(observed_walls, fires: int = 0,
+                   label: str = "dispatch") -> dict:
+    """Shadow-watchdog provenance for a bench artifact.
+
+    Feeds the bench's observed per-dispatch walls through the REAL
+    auto-mode EMA (``core/watchdog.py``) and stamps the deadline a
+    ``--watchdog auto`` run would settle at, alongside the fire count
+    (0 for an unmonitored bench).  With this next to the contention
+    stamp, a BENCH artifact can distinguish a hang (deadline would
+    fire) from a straggler (wall above EMA, below deadline) after the
+    fact."""
+    from fast_autoaugment_tpu.core.watchdog import DispatchWatchdog
+
+    walls = [float(w) for w in observed_walls if w and w > 0]
+    stamp = {"watchdog_fires": int(fires)}
+    if not walls:
+        stamp["watchdog_deadline_sec"] = None
+        return stamp
+    wd = DispatchWatchdog("auto")
+    for w in walls:
+        wd.observe(label, w)
+    stamp["watchdog_deadline_sec"] = round(wd.deadline(label), 6)
+    stamp["watchdog_ema_sec"] = round(wd.ema(label) or 0.0, 6)
+    stamp["watchdog_max_observed_sec"] = round(max(walls), 6)
+    return stamp
+
+
 def vs_baseline(images_per_sec: float, cpu_fallback: bool) -> float | None:
     """Ratio against the reference-pipeline estimate, or None on the CPU
     fallback: comparing a CPU plumbing heartbeat against the TPU-class
@@ -641,6 +668,12 @@ def bench_step_dispatch(ns=(1, 8, 32), steps=None) -> dict:
     top = out["train_steps_per_sec"].get(f"cache_n{max(ns)}")
     if base and top:
         out["speedup_cache_max_n_vs_hostfeed"] = round(top / base, 2)
+    # per-config shadow-watchdog stamp from the implied per-dispatch
+    # wall (a cache_nN dispatch advances N steps)
+    out["watchdog"] = {
+        cfg: watchdog_stamp([int(cfg.rsplit("n", 1)[1]) / rate], label=cfg)
+        for cfg, rate in out["train_steps_per_sec"].items() if rate
+    }
     return out
 
 
@@ -667,6 +700,7 @@ def main():
             "probe": sd["probe"],
             "speedup_cache_max_n_vs_hostfeed": sd.get(
                 "speedup_cache_max_n_vs_hostfeed"),
+            "watchdog": sd.get("watchdog"),
             "backend": ("cpu-fallback"
                         if os.environ.get("FAA_BENCH_CPU_FALLBACK")
                         else __import__("jax").devices()[0].platform),
@@ -810,6 +844,10 @@ def main():
         "batch_per_device": BATCH_PER_DEVICE,
         "devices": n_dev,
         "contention": contention,
+        # hang-vs-straggler provenance (docs/RESILIENCE.md): the
+        # auto-watchdog deadline these step walls imply + fires (0 —
+        # the bench is unmonitored)
+        "watchdog": watchdog_stamp(step_times, label="train_step"),
     }
 
     # search-scheduler throughput: trials/sec at --trial-batch K

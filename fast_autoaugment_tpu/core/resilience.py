@@ -38,6 +38,7 @@ from fast_autoaugment_tpu.utils.logging import get_logger
 __all__ = [
     "PREEMPTED_EXIT_CODE",
     "CheckpointCorruptError",
+    "DispatchHungError",
     "PreemptedError",
     "install_signal_handlers",
     "preemption_requested",
@@ -64,6 +65,29 @@ class PreemptedError(RuntimeError):
     the process should exit :data:`PREEMPTED_EXIT_CODE`."""
 
     exit_code = PREEMPTED_EXIT_CODE
+
+
+class DispatchHungError(RuntimeError):
+    """A monitored device dispatch blew past its watchdog deadline
+    (``core/watchdog.py``) — the scalar-collective rendezvous deadlock
+    class measured in PR 4, or any other wedged XLA dispatch.  The
+    in-flight device state is unrecoverable (its buffers are donated to
+    the hung computation), so recovery is the PROCESS-restart arm of
+    the exit-77 contract: the CLIs map this to
+    :data:`PREEMPTED_EXIT_CODE` and the relaunch resumes from the
+    newest intact checkpoint-chain link.  A wedged rendezvous costs one
+    process restart, not the run."""
+
+    exit_code = PREEMPTED_EXIT_CODE
+
+    def __init__(self, label: str, deadline_sec: float, waited_sec: float):
+        super().__init__(
+            f"dispatch {label!r} exceeded its watchdog deadline "
+            f"({waited_sec:.1f}s waited > {deadline_sec:.1f}s allowed) — "
+            "treating the dispatch as hung")
+        self.label = label
+        self.deadline_sec = deadline_sec
+        self.waited_sec = waited_sec
 
 
 # -- the preemption flag ----------------------------------------------

@@ -1,0 +1,215 @@
+"""Monitored device dispatch: deadline-guarded execution, hang recovery.
+
+The scalar-collective rendezvous deadlock measured in PR 4 (a few
+hundred queued unsynced collective programs wedge the virtual-device
+CPU backend's rendezvous — ``train/steps.py::make_replay_eval_step``)
+is the concrete local instance of a general multi-host hazard: an XLA
+dispatch that never completes.  Multi-host pjit deployments treat hang
+detection as table stakes (PAPERS.md: *Scalable Training of Language
+Models using JAX pjit and TPUv4*), because a wedged rendezvous blocks
+EVERY participant forever — there is no exception to catch, the
+process just stops making progress.
+
+:class:`DispatchWatchdog` wraps a device dispatch (a jitted call plus
+the ``block_until_ready`` on its outputs) in a worker thread and waits
+with a deadline:
+
+- the deadline derives from an **EMA of observed per-dispatch wall
+  time** (``auto`` mode: ``max(min_deadline, hang_factor x EMA)``) or
+  is a fixed operator-supplied number of seconds;
+- the **first call per label gets a separate, generous compile
+  allowance** — XLA compiles on first dispatch and a 30-55 s compile
+  (BENCH_r02-r05) must never read as a hang;
+- expiry raises the typed
+  :class:`~fast_autoaugment_tpu.core.resilience.DispatchHungError`.
+  The hung computation holds the donated state buffers, so there is
+  nothing to checkpoint — the CLIs map the error to exit 77 and the
+  relaunch resumes from the newest intact chain link (pair with
+  ``--ckpt-every-dispatch M`` to bound the replayed work).
+
+Blocking on each monitored dispatch serializes the dispatch pipeline,
+which is why the default is **off** (bit-for-bit the historical async
+stream — blocking changes wall time, never values).  ``--watchdog
+auto`` (or an explicit deadline) buys hang detection for that cost.
+
+Deterministic tests drive this through the ``FAA_FAULT`` verbs
+``hang@step=K`` (the dispatch covering step K sleeps forever) and
+``slow@step=K,factor=F`` (a straggler: the dispatch takes F x the
+current EMA) — ``utils/faultinject.py``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+from fast_autoaugment_tpu.core.resilience import DispatchHungError
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = ["DispatchWatchdog", "resolve_watchdog", "DispatchHungError"]
+
+logger = get_logger("faa_tpu.watchdog")
+
+#: first-call-per-label deadline: covers XLA compile (observed 23-55 s
+#: per process on this repo's models, BENCH_r02-r05) with slack
+DEFAULT_COMPILE_ALLOWANCE_SEC = 600.0
+#: auto mode: deadline = max(min_deadline, hang_factor * EMA)
+DEFAULT_HANG_FACTOR = 20.0
+DEFAULT_MIN_DEADLINE_SEC = 10.0
+#: EMA smoothing for observed dispatch wall times
+DEFAULT_EMA_ALPHA = 0.2
+
+
+class DispatchWatchdog:
+    """Deadline-guarded dispatch execution with per-label EMA timing.
+
+    ``mode`` is ``"off"`` (disabled — :meth:`run` calls through with
+    zero overhead), ``"auto"`` (EMA-derived deadlines), or a positive
+    float (fixed steady-state deadline in seconds; the first call per
+    label still gets ``max(seconds, compile_allowance)``).
+
+    One instance is shared across a whole run (trainer + search) so
+    :attr:`fires` aggregates every monitored seam; labels keep their
+    own EMA because a train dispatch chunk and a whole-split eval
+    replay have very different steady-state walls.
+    """
+
+    def __init__(self, mode: str | float = "off", *,
+                 compile_allowance: float = DEFAULT_COMPILE_ALLOWANCE_SEC,
+                 hang_factor: float = DEFAULT_HANG_FACTOR,
+                 min_deadline: float = DEFAULT_MIN_DEADLINE_SEC,
+                 ema_alpha: float = DEFAULT_EMA_ALPHA):
+        if isinstance(mode, str):
+            mode = mode.strip().lower()
+            if mode not in ("off", "auto"):
+                mode = float(mode)  # "SECONDS" string from the CLI
+        if isinstance(mode, (int, float)):
+            if float(mode) <= 0:
+                raise ValueError(f"watchdog deadline must be > 0, got {mode}")
+            mode = float(mode)
+        self.mode = mode
+        self.compile_allowance = float(compile_allowance)
+        self.hang_factor = float(hang_factor)
+        self.min_deadline = float(min_deadline)
+        self.ema_alpha = float(ema_alpha)
+        self.fires = 0
+        self._ema: dict[str, float] = {}
+        self._calls: dict[str, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def ema(self, label: str) -> float | None:
+        """Current EMA of observed wall seconds for `label` (None until
+        the first completed call)."""
+        return self._ema.get(label)
+
+    def deadline(self, label: str) -> float:
+        """The deadline the NEXT :meth:`run` for `label` will use."""
+        first = self._calls.get(label, 0) == 0
+        if isinstance(self.mode, float):
+            return max(self.mode, self.compile_allowance) if first else self.mode
+        # auto: generous compile allowance first, then EMA-derived
+        if first or label not in self._ema:
+            return self.compile_allowance
+        return max(self.min_deadline, self.hang_factor * self._ema[label])
+
+    def observe(self, label: str, wall_sec: float) -> None:
+        """Fold one observed dispatch wall time into the label's EMA.
+
+        The first observation seeds the EMA directly — it is the
+        compile call, but using it only ever makes deadlines MORE
+        generous until steady-state observations pull the EMA down."""
+        self._calls[label] = self._calls.get(label, 0) + 1
+        prev = self._ema.get(label)
+        if prev is None:
+            self._ema[label] = float(wall_sec)
+        else:
+            self._ema[label] = (self.ema_alpha * float(wall_sec)
+                                + (1.0 - self.ema_alpha) * prev)
+
+    def run(self, label: str, fn: Callable, *args: Any,
+            inject_delay: float = 0.0) -> Any:
+        """Run ``fn(*args)`` (plus ``block_until_ready`` on its result)
+        under the label's deadline.
+
+        Disabled mode calls through inline with zero overhead — except
+        that an injected delay (the ``hang``/``slow`` fault verbs)
+        still sleeps, reproducing the real unwatched wedge.  Raises
+        :class:`DispatchHungError` on expiry; the worker thread is a
+        daemon, so an actually-wedged dispatch cannot block process
+        exit (the recovery IS a process exit)."""
+        import jax
+
+        if not self.enabled:
+            _sleep(inject_delay)
+            return jax.block_until_ready(fn(*args))
+
+        deadline = self.deadline(label)
+        out_q: queue.Queue = queue.Queue(maxsize=1)
+        t0 = time.monotonic()
+
+        def _worker():
+            try:
+                _sleep(inject_delay)
+                out = jax.block_until_ready(fn(*args))
+                out_q.put(("ok", out, time.monotonic() - t0))
+            except BaseException as e:  # delivered to the caller below
+                out_q.put(("err", e, time.monotonic() - t0))
+
+        worker = threading.Thread(target=_worker, daemon=True,
+                                  name=f"watchdog-{label}")
+        worker.start()
+        try:
+            kind, value, wall = out_q.get(timeout=deadline)
+        except queue.Empty:
+            self.fires += 1
+            waited = time.monotonic() - t0
+            logger.error(
+                "watchdog FIRED on %r: no completion after %.1fs "
+                "(deadline %.1fs, ema %s) — dispatch presumed hung",
+                label, waited, deadline,
+                f"{self._ema[label]:.3f}s" if label in self._ema else "n/a")
+            raise DispatchHungError(label, deadline, waited)
+        if kind == "err":
+            raise value
+        self.observe(label, wall)
+        return value
+
+    def stats(self) -> dict:
+        """Artifact-ready accounting: mode, fire count, per-label
+        deadlines + EMAs (stamped into bench JSON and
+        ``search_result.json`` so hangs and stragglers are
+        distinguishable after the fact)."""
+        return {
+            "mode": self.mode if isinstance(self.mode, str) else float(self.mode),
+            "fires": self.fires,
+            "deadline_sec": {lb: self.deadline(lb) for lb in self._calls},
+            "ema_sec": {lb: round(v, 6) for lb, v in self._ema.items()},
+        }
+
+
+def _sleep(delay: float) -> None:
+    """Sleep `delay` seconds in bounded chunks (`inf` = sleep forever —
+    the injected-hang case; chunking sidesteps time.sleep's OverflowError
+    on infinite values)."""
+    if not delay or delay <= 0:
+        return
+    remaining = float(delay)
+    while remaining > 0:
+        time.sleep(min(remaining, 60.0))
+        remaining -= 60.0
+
+
+def resolve_watchdog(spec, **kwargs) -> DispatchWatchdog:
+    """``--watchdog {off,auto,SECONDS}`` (or an existing instance) to a
+    :class:`DispatchWatchdog`.  Passing an instance through unchanged
+    lets one watchdog aggregate fire counts across the whole search."""
+    if isinstance(spec, DispatchWatchdog):
+        return spec
+    if spec is None:
+        spec = "off"
+    return DispatchWatchdog(spec, **kwargs)

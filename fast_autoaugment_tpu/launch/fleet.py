@@ -35,6 +35,27 @@ Resilience additions (docs/RESILIENCE.md):
   wait order and failure order disagreed;
 - the final log line reports per-host attempt counts.
 
+Self-healing fleet additions (this is the supervisor half of the
+``launch/workqueue.py`` lease layer — docs/RESILIENCE.md
+"Self-healing fleet"):
+
+- ``--elastic``: a host that fails FOR GOOD no longer tears the fleet
+  down — it is declared LOST, the survivors keep running, and (when
+  the workers share a ``--workqueue``) they reclaim the dead host's
+  stale leases and finish its work units.  The fleet completes with
+  any >= 1 live host; exit 0 when at least one host succeeded.
+- ``--workqueue DIR --heartbeat-timeout S``: the supervisor consumes
+  the workers' host heartbeats (``DIR/hosts/<tag>.json``, written at
+  dispatch/round boundaries).  A process that is ALIVE but whose beat
+  is older than S is WEDGED beyond what its in-process watchdog could
+  catch (e.g. the interpreter itself is stuck in a rendezvous) — the
+  supervisor SIGKILLs it and the normal retry path relaunches it,
+  resuming from the checkpoint chain.
+- every supervisor log line carries ``host=<id> attempt=<n>`` so
+  interleaved multi-host logs stay attributable; each launch exports
+  ``FAA_ATTEMPT=<n>`` so fault-injection specs can be gated to a
+  specific attempt in the process chain (``utils/faultinject.py``).
+
     python -m fast_autoaugment_tpu.launch.fleet --hosts host1,host2,host3,host4 \
         --coordinator host1:8476 -- python -m fast_autoaugment_tpu.launch.train_cli \
         -c confs/resnet50.yaml --dataroot /data
@@ -84,6 +105,11 @@ class _Fleet:
         self.teardown = threading.Event()
         # (monotonic time, host, code) of genuine failures, in order
         self.failures: list[tuple[float, str, int]] = []
+        # hosts that eventually exited 0 / were declared lost (elastic)
+        self.successes: list[str] = []
+        self.lost: list[str] = []
+        # wedged processes the heartbeat monitor had to kill
+        self.hang_kills = 0
 
     def track(self, p: subprocess.Popen):
         with self._lock:
@@ -96,6 +122,14 @@ class _Fleet:
     def record_failure(self, host: str, code: int):
         with self._lock:
             self.failures.append((time.monotonic(), host, code))
+
+    def record_success(self, host: str):
+        with self._lock:
+            self.successes.append(host)
+
+    def record_lost(self, host: str):
+        with self._lock:
+            self.lost.append(host)
 
     def kill_all(self, sig=signal.SIGTERM):
         with self._lock:
@@ -112,86 +146,166 @@ class _Fleet:
                     pass
 
 
-def _stream(host: str, pipe, out):
+def _stream(prefix: str, pipe, out):
     for line in iter(pipe.readline, b""):
-        out.write(f"[{host}] ".encode() + line)
+        out.write(prefix.encode() + line)
         out.flush()
     pipe.close()
+
+
+def _heartbeat_age(workqueue_dir: str, host_tag: str) -> float | None:
+    """Seconds since the worker's last host beat, None when unknown
+    (no beat yet — e.g. still compiling — or unreadable mid-write) or
+    when the worker marked itself done (finished, not wedged)."""
+    import json
+
+    path = os.path.join(workqueue_dir, "hosts", f"{host_tag}.json")
+    try:
+        with open(path) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if rec.get("done"):
+        return None
+    try:
+        return max(0.0, time.time() - float(rec["heartbeat"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _wait_with_heartbeat(fleet: _Fleet, p: subprocess.Popen, host: str,
+                         attempt: int, host_tag: str,
+                         workqueue_dir: str | None,
+                         heartbeat_timeout: float) -> int:
+    """Wait for the process; with a workqueue + timeout configured,
+    SIGKILL it when its host beat goes stale — the beyond-the-watchdog
+    wedge (the interpreter itself stuck in a rendezvous) that no
+    in-process deadline can catch."""
+    if not workqueue_dir or heartbeat_timeout <= 0:
+        return p.wait()
+    while True:
+        try:
+            return p.wait(timeout=max(0.2, heartbeat_timeout / 4.0))
+        except subprocess.TimeoutExpired:
+            if fleet.teardown.is_set():
+                return p.wait()
+            age = _heartbeat_age(workqueue_dir, host_tag)
+            if age is not None and age > heartbeat_timeout:
+                logger.warning(
+                    "host=%s attempt=%d heartbeat %.1fs stale "
+                    "(timeout %.1fs) — killing WEDGED process",
+                    host, attempt, age, heartbeat_timeout)
+                fleet.hang_kills += 1
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                return p.wait()
 
 
 def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
                coordinator: str, num_hosts: int,
                env_passthrough: tuple[str, ...], host_retries: int,
-               retry_backoff: float, attempts_out: dict):
+               retry_backoff: float, attempts_out: dict,
+               elastic: bool = False, workqueue_dir: str | None = None,
+               heartbeat_timeout: float = 0.0):
     """Launch + babysit one host: relaunch on failure (exit 77 included)
-    up to `host_retries` times with exponential backoff; on final
-    failure record the code and trigger fleet teardown."""
+    up to `host_retries` times with exponential backoff, SIGKILLing a
+    heartbeat-stale (wedged) process first when configured; on final
+    failure either tear the fleet down (default) or — ``elastic`` —
+    declare the host LOST and let the survivors finish its work."""
     remote_cmd = command + [
         "--coordinator", coordinator,
         "--num-hosts", str(num_hosts),
         "--host-id", str(host_id),
     ]
-    envs = " ".join(
+    host_tag = f"host{host_id}"
+    base_envs = " ".join(
         f"{k}={shlex.quote(os.environ[k])}"
         for k in env_passthrough if k in os.environ
-    )
-    # NO setsid: the remote command must keep the ssh pty as its
-    # controlling terminal so pty teardown HUPs the whole foreground
-    # group — a setsid-detached tree would never see the hangup and
-    # Ctrl-C here would orphan remote training processes
-    # (safe_shell_exec.py:98-131 solves the same problem with an
-    # explicit signal-forwarding middleman)
-    wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec " + " ".join(
-        shlex.quote(c) for c in remote_cmd
     )
     attempt = 0
     while not fleet.teardown.is_set():
         attempt += 1
         attempts_out[host] = attempt
+        # FAA_ATTEMPT gates fault-injection specs to one attempt in the
+        # process chain (a relaunch re-reads the same FAA_FAULT)
+        envs = f"{base_envs} FAA_ATTEMPT={attempt}".strip()
+        # NO setsid: the remote command must keep the ssh pty as its
+        # controlling terminal so pty teardown HUPs the whole foreground
+        # group — a setsid-detached tree would never see the hangup and
+        # Ctrl-C here would orphan remote training processes
+        # (safe_shell_exec.py:98-131 solves the same problem with an
+        # explicit signal-forwarding middleman)
+        wire = f"cd {shlex.quote(os.getcwd())} && {envs} exec " + " ".join(
+            shlex.quote(c) for c in remote_cmd
+        )
         full = _remote_argv(host, wire)
-        logger.info("[%s] (attempt %d) %s", host, attempt, " ".join(full))
+        logger.info("host=%s attempt=%d launching: %s", host, attempt,
+                    " ".join(full))
         try:
             p = subprocess.Popen(
                 full, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 start_new_session=True,
             )
         except FileNotFoundError:
-            logger.error("ssh binary not found — the fleet launcher needs "
-                         "an ssh client on the controlling host")
+            logger.error("host=%s attempt=%d ssh binary not found — the "
+                         "fleet launcher needs an ssh client on the "
+                         "controlling host", host, attempt)
             fleet.record_failure(host, 127)
             fleet.teardown.set()
             fleet.kill_all()
             return
         fleet.track(p)
-        t = threading.Thread(target=_stream,
-                             args=(host, p.stdout, sys.stdout.buffer),
-                             daemon=True)
+        if fleet.teardown.is_set():
+            # we raced the teardown: a sibling failed between our
+            # launch check and track(), so its kill_all() missed this
+            # process — kill it ourselves or it outlives the fleet
+            fleet.kill_all()
+        t = threading.Thread(
+            target=_stream,
+            args=(f"[host={host} attempt={attempt}] ", p.stdout,
+                  sys.stdout.buffer),
+            daemon=True)
         t.start()
-        code = p.wait()
+        code = _wait_with_heartbeat(fleet, p, host, attempt, host_tag,
+                                    workqueue_dir, heartbeat_timeout)
         t.join(timeout=2)
         fleet.untrack(p)
         if code == 0:
+            fleet.record_success(host)
             return
         if fleet.teardown.is_set():
             # killed by (or failed during) teardown: NOT a root cause
-            logger.info("[%s] exited %d during teardown", host, code)
+            logger.info("host=%s attempt=%d exited %d during teardown",
+                        host, attempt, code)
             return
         preempted = code == PREEMPTED_EXIT_CODE
         if attempt <= host_retries:
             delay = retry_backoff * (2 ** (attempt - 1))
             logger.warning(
-                "[%s] exited %d (%s) — relaunching in %.1fs "
-                "(attempt %d/%d)", host, code,
+                "host=%s attempt=%d exited %d (%s) — relaunching in %.1fs "
+                "(attempt %d/%d)", host, attempt, code,
                 "preempted: resume me" if preempted else "failed",
                 delay, attempt, host_retries + 1)
             # interruptible sleep: a teardown elsewhere aborts the retry
             if fleet.teardown.wait(delay):
                 return
             continue
-        logger.warning("[%s] exited %d (%s) — out of retries, tearing "
-                       "down fleet", host, code,
-                       "preempted" if preempted else "failed")
         fleet.record_failure(host, code)
+        if elastic:
+            # degraded-mode completion: survivors keep running and (via
+            # the shared workqueue) reclaim this host's stale leases
+            fleet.record_lost(host)
+            logger.warning(
+                "host=%s attempt=%d exited %d (%s) — out of retries; "
+                "host LOST, elastic fleet continues degraded (survivors "
+                "reclaim its work units)", host, attempt, code,
+                "preempted" if preempted else "failed")
+            return
+        logger.warning("host=%s attempt=%d exited %d (%s) — out of "
+                       "retries, tearing down fleet", host, attempt, code,
+                       "preempted" if preempted else "failed")
         fleet.teardown.set()
         fleet.kill_all()
         return
@@ -201,7 +315,10 @@ def launch_fleet(hosts: list[str], command: list[str],
                  coordinator: str | None,
                  env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",),
                  host_retries: int = 0,
-                 retry_backoff: float = 1.0) -> int:
+                 retry_backoff: float = 1.0,
+                 elastic: bool = False,
+                 workqueue_dir: str | None = None,
+                 heartbeat_timeout: float = 0.0) -> int:
     """Run `command` on every host over SSH; returns the first genuine
     failure's exit code (0 when every host eventually succeeds).
 
@@ -209,7 +326,16 @@ def launch_fleet(hosts: list[str], command: list[str],
     starting at `retry_backoff` seconds) before the failure counts;
     exit 77 (preempted — state checkpointed, docs/RESILIENCE.md) is
     retry-eligible like any failure, and the relaunch resumes from the
-    checkpoint."""
+    checkpoint.
+
+    `elastic` completes the fleet with any >= 1 live host: a host out
+    of retries is declared LOST instead of tearing the fleet down, and
+    the exit code is 0 when at least one host succeeded (the workers'
+    shared ``--workqueue`` makes the survivors finish the dead host's
+    units).  `workqueue_dir` + `heartbeat_timeout` arm the wedge
+    detector: an alive process whose host beat under
+    ``<dir>/hosts/host<id>.json`` is older than the timeout is
+    SIGKILLed and relaunched through the normal retry path."""
     fleet = _Fleet()
     coordinator = coordinator or f"{hosts[0]}:8476"
     host_retries = max(0, int(host_retries))
@@ -229,14 +355,19 @@ def launch_fleet(hosts: list[str], command: list[str],
         t = threading.Thread(
             target=_supervise,
             args=(fleet, host_id, host, command, coordinator, len(hosts),
-                  env_passthrough, host_retries, retry_backoff, attempts),
+                  env_passthrough, host_retries, retry_backoff, attempts,
+                  elastic, workqueue_dir, heartbeat_timeout),
             daemon=True,
         )
         t.start()
         supervisors.append(t)
     try:
         for t in supervisors:
-            t.join()
+            # bounded joins (lint R4): the supervisor threads exit on
+            # their own, but an untimed join here would silently hang
+            # the whole launcher if one ever wedged
+            while t.is_alive():
+                t.join(timeout=5.0)
     finally:
         fleet.teardown.set()
         fleet.kill_all()
@@ -251,11 +382,22 @@ def launch_fleet(hosts: list[str], command: list[str],
     if fleet.failures:
         fleet.failures.sort(key=lambda f: f[0])
         _, first_host, worst = fleet.failures[0]
-        logger.warning("fleet: first genuine failure on [%s] with exit %d",
+        logger.warning("fleet: first genuine failure on host=%s with exit %d",
                        first_host, worst)
+    if elastic and fleet.successes and worst != 0:
+        # degraded completion: >= 1 host finished the (shared-queue)
+        # work, so the FLEET succeeded even though hosts were lost —
+        # the worker stamped degraded/lost_hosts into the result
+        logger.warning(
+            "fleet: DEGRADED completion — %d host(s) lost (%s), %d "
+            "succeeded; exit 0", len(fleet.lost),
+            ",".join(fleet.lost) or "-", len(fleet.successes))
+        worst = 0
     logger.info(
-        "fleet done: exit %d; attempts per host: %s", worst,
-        " ".join(f"{h}={attempts.get(h, 0)}" for h in hosts))
+        "fleet done: exit %d; attempts per host: %s%s%s", worst,
+        " ".join(f"{h}={attempts.get(h, 0)}" for h in hosts),
+        f"; lost: {','.join(fleet.lost)}" if fleet.lost else "",
+        f"; wedged-killed: {fleet.hang_kills}" if fleet.hang_kills else "")
     return worst
 
 
@@ -270,6 +412,22 @@ def main(argv=None):
                         "the relaunch RESUMES (docs/RESILIENCE.md)")
     p.add_argument("--retry-backoff", type=float, default=1.0,
                    help="base seconds for the exponential retry backoff")
+    p.add_argument("--elastic", action="store_true",
+                   help="degraded-mode completion: a host out of retries "
+                        "is declared LOST instead of tearing the fleet "
+                        "down; survivors keep running (and, with a shared "
+                        "--workqueue, reclaim its work units).  Fleet "
+                        "exit 0 when >= 1 host succeeds "
+                        "(docs/RESILIENCE.md 'Self-healing fleet')")
+    p.add_argument("--workqueue", default=None, metavar="DIR",
+                   help="the workers' shared lease-queue dir (pass the "
+                        "same DIR to the worker CLI); arms the "
+                        "supervisor-side heartbeat wedge detector")
+    p.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                   help="SIGKILL + relaunch an ALIVE worker whose "
+                        "DIR/hosts/host<id>.json beat is older than this "
+                        "many seconds — the interpreter-level wedge the "
+                        "in-process --watchdog cannot catch.  0 = off")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run on every host (prefix with --)")
     args = p.parse_args(argv)
@@ -281,7 +439,10 @@ def main(argv=None):
     hosts = expand_hosts(args.hosts)
     code = launch_fleet(hosts, command, args.coordinator,
                         host_retries=args.host_retries,
-                        retry_backoff=args.retry_backoff)
+                        retry_backoff=args.retry_backoff,
+                        elastic=args.elastic,
+                        workqueue_dir=args.workqueue,
+                        heartbeat_timeout=args.heartbeat_timeout)
     sys.exit(code)
 
 

@@ -20,6 +20,7 @@ import numpy as np
 from fast_autoaugment_tpu.core.config import load_config
 from fast_autoaugment_tpu.core.resilience import (
     PREEMPTED_EXIT_CODE,
+    DispatchHungError,
     PreemptedError,
     install_signal_handlers,
 )
@@ -47,6 +48,23 @@ def _quality_floor_arg(value: str) -> str:
         # silently disable the gate downstream
         raise argparse.ArgumentTypeError(
             f"expected a finite float, got {value!r}")
+    return value
+
+
+def _watchdog_arg(value: str) -> str:
+    """Validate ``--watchdog`` at parse time: 'off', 'auto', or a
+    positive float deadline in seconds."""
+    v = value.lower()
+    if v in ("off", "auto"):
+        return v
+    try:
+        f = float(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'off', 'auto' or SECONDS, got {value!r}")
+    if not math.isfinite(f) or f <= 0:
+        raise argparse.ArgumentTypeError(
+            f"expected a positive finite deadline, got {value!r}")
     return value
 
 
@@ -188,6 +206,47 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mid-epoch snapshot every M dispatch chunks in "
                         "phase-3 retrains (device-cache path; bit-"
                         "identical dispatch-boundary resume).  0 = off")
+    p.add_argument("--watchdog", default="off", type=_watchdog_arg,
+                   help="dispatch watchdog: deadline-guard every device "
+                        "dispatch (train chunks, TTA/eval replays) and "
+                        "treat one that blows its deadline as HUNG — the "
+                        "typed DispatchHungError maps to exit 77 and the "
+                        "relaunch resumes from the newest checkpoint-chain "
+                        "link.  'off' (default) = the historical async "
+                        "dispatch bit-for-bit; 'auto' = deadlines from an "
+                        "EMA of observed dispatch wall times (generous "
+                        "first-call compile allowance); SECONDS = a fixed "
+                        "deadline (docs/RESILIENCE.md)")
+    p.add_argument("--workqueue", default=None, metavar="DIR",
+                   help="elastic multi-host scatter: claim phase-1 fold "
+                        "trainings and per-fold phase-2 searches off a "
+                        "lease queue under DIR (a directory every host "
+                        "mounts), renewing leases at dispatch/round "
+                        "boundaries and RECLAIMING units whose lease went "
+                        "stale — a dead host's fold is finished by a "
+                        "survivor and the search completes with any >= 1 "
+                        "live host, stamping degraded/lost_hosts/"
+                        "reclaimed_units into search_result.json.  "
+                        "Replaces the static --folds assignment "
+                        "(docs/RESILIENCE.md 'Self-healing fleet')")
+    p.add_argument("--lease-ttl", type=float, default=60.0,
+                   help="seconds without a heartbeat before a --workqueue "
+                        "lease counts as stale and survivors may reclaim "
+                        "its unit (must dominate NTP skew + the longest "
+                        "dispatch gap between renewals)")
+    p.add_argument("--host-tag", default=None,
+                   help="this host's stable owner id in the --workqueue "
+                        "(default: host<--host-id> under the fleet "
+                        "launcher, else host<pid>).  A relaunch must "
+                        "REUSE its predecessor's tag to resume its own "
+                        "leases without waiting out the TTL")
+    # accepted so the fleet launcher can drive this CLI like train_cli;
+    # --host-id doubles as the default --host-tag
+    p.add_argument("--coordinator", default=None,
+                   help="host0 addr for multi-host JAX (fleet launcher "
+                        "passes it; only used when --workqueue is unset)")
+    p.add_argument("--num-hosts", type=int, default=None)
+    p.add_argument("--host-id", type=int, default=None)
     p.add_argument("--audit-floor", type=float, default=0.95,
                    help="drop selected sub-policies whose standalone "
                         "mean-over-draws fold accuracy < floor x baseline "
@@ -202,6 +261,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None):
     args = build_parser().parse_args(argv)
     conf = load_config(args.conf, overrides=args.override)
+    if args.coordinator and not args.workqueue:
+        # one JAX job spanning hosts (the train_cli contract); in
+        # --workqueue mode every host is its own single-process JAX job
+        # sharing only the artifact directory
+        from fast_autoaugment_tpu.parallel.mesh import distributed_init
+
+        distributed_init(args.coordinator, args.num_hosts, args.host_id)
     # SIGTERM/SIGUSR1 -> graceful preemption: the in-flight training run
     # checkpoints at its next safe boundary (per-trial logs are already
     # persisted per round) and the process exits 77 = "resume me"
@@ -216,9 +282,37 @@ def main(argv=None):
             "resumes from the per-fold checkpoints and trial log",
             e, PREEMPTED_EXIT_CODE)
         raise SystemExit(PREEMPTED_EXIT_CODE)
+    except DispatchHungError as e:
+        logger.error(
+            "dispatch HUNG (%s) — the in-flight device state is "
+            "unrecoverable; exiting %d so the supervisor relaunches and "
+            "the rerun resumes from the newest checkpoint-chain link",
+            e, PREEMPTED_EXIT_CODE)
+        raise SystemExit(PREEMPTED_EXIT_CODE)
+
+
+def _build_workqueue(args):
+    """The shared lease queue (or None): owner tag priority is
+    --host-tag, then host<--host-id> (the fleet launcher's stable
+    per-host identity — a relaunch reclaims its own leases
+    immediately), then host<pid>."""
+    if not args.workqueue:
+        return None
+    import os
+
+    from fast_autoaugment_tpu.launch.workqueue import WorkQueue
+
+    tag = args.host_tag or (
+        f"host{args.host_id}" if args.host_id is not None
+        else f"host{os.getpid()}")
+    wq = WorkQueue(args.workqueue, tag, lease_ttl=args.lease_ttl)
+    logger.info("workqueue: owner=%s root=%s lease_ttl=%.1fs",
+                tag, args.workqueue, args.lease_ttl)
+    return wq
 
 
 def _run(args, conf, t_start):
+    work_queue = _build_workqueue(args)
     result = search_policies(
         conf,
         dataroot=args.dataroot,
@@ -247,6 +341,8 @@ def _run(args, conf, t_start):
         steps_per_dispatch=args.steps_per_dispatch,
         divergence_retries=args.divergence_retries,
         ckpt_keep=args.ckpt_keep,
+        watchdog=args.watchdog,
+        work_queue=work_queue,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []
@@ -285,7 +381,25 @@ def _run(args, conf, t_start):
         return result
 
     if args.until < 3 or not final_policy_set:
+        if work_queue is not None:
+            work_queue.mark_host_done()
         return persist()
+
+    phase3_hb = None
+    if work_queue is not None:
+        # phase 3 is one unit: exactly one host runs the retrains (a
+        # stale lease lets a survivor reclaim them; per-run checkpoints
+        # make the rerun resume)
+        if not work_queue.claim("phase3"):
+            logger.info(
+                "workqueue: phase 3 is owned elsewhere (or done) — this "
+                "host is finished; the owner persists the final result")
+            work_queue.mark_host_done()
+            return persist()
+
+        def phase3_hb():
+            work_queue.renew("phase3")
+            work_queue.beat_host()
 
     # phase 3: full retrains, default vs augmented (search.py:264-312)
     # plus an optional random-policy control arm.  Unlike the
@@ -342,6 +456,7 @@ def _run(args, conf, t_start):
                 divergence_retries=args.divergence_retries,
                 ckpt_keep=args.ckpt_keep,
                 checkpoint_every_dispatch=args.ckpt_every_dispatch,
+                watchdog=args.watchdog, heartbeat=phase3_hb,
             )
             outcomes[mode].append(float(res.get("top1_test", 0.0)))
             logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,
@@ -358,6 +473,9 @@ def _run(args, conf, t_start):
     logger.info("phase3 (n=%d): %s%s", num_runs, summary,
                 " [%s]" % pvals if pvals else "")
 
+    if work_queue is not None:
+        work_queue.release("phase3", info={"num_runs": num_runs})
+        work_queue.mark_host_done()
     persist()
     logger.info("search complete: %.3f device-hours on %s",
                 result["tpu_hours_total"], result.get("backend", "?"))

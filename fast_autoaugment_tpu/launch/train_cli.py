@@ -19,6 +19,7 @@ import time
 from fast_autoaugment_tpu.core.config import load_config
 from fast_autoaugment_tpu.core.resilience import (
     PREEMPTED_EXIT_CODE,
+    DispatchHungError,
     PreemptedError,
     install_signal_handlers,
 )
@@ -85,6 +86,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(device-cache path only; resumable bit-identically "
                         "from the exact dispatch boundary).  0 (default) = "
                         "checkpoint at evaluation epochs only")
+    p.add_argument("--watchdog", default="off",
+                   help="dispatch watchdog {off,auto,SECONDS}: run every "
+                        "train dispatch / eval replay under a deadline "
+                        "(auto = EMA of observed dispatch wall times with "
+                        "a generous first-call compile allowance) and "
+                        "treat expiry as a HUNG dispatch — exit 77 so the "
+                        "supervisor relaunches and the rerun resumes from "
+                        "the newest checkpoint-chain link (pair with "
+                        "--ckpt-every-dispatch to bound replayed work).  "
+                        "'off' (default) keeps the historical async "
+                        "dispatch bit-for-bit (docs/RESILIENCE.md)")
     p.add_argument("--coordinator", default=None, help="host0 addr for multi-host")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
@@ -128,10 +140,17 @@ def main(argv=None):
             divergence_retries=args.divergence_retries,
             ckpt_keep=args.ckpt_keep,
             checkpoint_every_dispatch=args.ckpt_every_dispatch,
+            watchdog=args.watchdog,
         )
     except PreemptedError as e:
         logger.warning("preempted (%s) — exiting %d so the supervisor "
                        "resumes this run", e, PREEMPTED_EXIT_CODE)
+        raise SystemExit(PREEMPTED_EXIT_CODE)
+    except DispatchHungError as e:
+        logger.error("dispatch HUNG (%s) — in-flight device state is "
+                     "unrecoverable; exiting %d so the supervisor "
+                     "relaunches and the rerun resumes from the newest "
+                     "checkpoint-chain link", e, PREEMPTED_EXIT_CODE)
         raise SystemExit(PREEMPTED_EXIT_CODE)
     elapsed = time.time() - t0
     logger.info("done %s: %s", args.tag, json.dumps(

@@ -1,0 +1,357 @@
+"""Lease-based work reclamation over a shared artifact directory.
+
+PR 5 made a single run survive faults; this layer makes the FLEET
+survive losing a machine.  The search's natural work units — phase-1
+fold trainings, per-fold phase-2 trial searches, gate retrains — are
+already resumable from the checkpoint chain + trial log by ANY host
+that can see the shared ``save_dir``; what was missing is an ownership
+protocol so a unit abandoned by a dead host is picked up by exactly
+one survivor.  Podracer-style pods run on preemptible hardware exactly
+this way (PAPERS.md: *Podracer architectures* — work units are
+reclaimable by survivors, progress lives in shared storage).
+
+Protocol (all state lives under ``<root>/``, assumed on a filesystem
+every host mounts — the same assumption the shared ``save_dir``
+scatter already makes):
+
+``leases/<unit>.json``
+    The lease: ``{unit, owner, attempt, heartbeat, claimed_at}``.
+    **Claim** is an atomic ``os.link`` of an owner-unique temp file
+    onto the lease path — exactly one linker wins, losers see
+    ``FileExistsError``.  **Renewal** rewrites the file (via
+    ``write_json_atomic``) with a fresh ``heartbeat`` wall-clock stamp;
+    the trainer calls it at dispatch-chunk boundaries and the phase-2
+    loop per trial round.  **Reclaim** of a stale lease (heartbeat
+    older than ``lease_ttl``) first renames the lease to a
+    fence path — ``os.rename`` succeeds for exactly one contender —
+    then claims fresh with ``attempt + 1`` and the dead owner recorded.
+``done/<unit>.json``
+    Completion marker (atomic write): ``{unit, owner, attempt,
+    reclaimed_from, info}``.  ``attempt > 1`` is the global
+    "this unit was reclaimed" signal any host can read at the end.
+``hosts/<owner>.json``
+    Host-level heartbeat (``beat_host``): consumed by the fleet
+    supervisor's wedge detector and by the degraded-mode accounting
+    (a host whose beat goes stale and never completes is ``lost``).
+
+Clocks: staleness compares wall-clock stamps ACROSS hosts, so the TTL
+must dominate NTP skew (seconds); the default 60 s does.  A stolen
+owner discovers the loss at its next renewal (``LeaseLostError``) and
+must stop working the unit — both sides write checkpoints through the
+same atomic chain, so the worst case of a slow-but-alive owner racing
+its reclaimer is duplicated compute, never corrupted state (writes are
+idempotent: same seeds, same chain).
+
+Fault injection: ``FAA_FAULT=stale_lease@unit=NAME`` drops renewals
+for NAME from the first match onward, driving the reclaim path
+deterministically in tests (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from fast_autoaugment_tpu.utils import faultinject
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = ["WorkQueue", "LeaseLostError", "DEFAULT_LEASE_TTL_SEC"]
+
+logger = get_logger("faa_tpu.workqueue")
+
+DEFAULT_LEASE_TTL_SEC = 60.0
+
+
+class LeaseLostError(RuntimeError):
+    """This host's lease on a unit was reclaimed by another host (it
+    missed enough heartbeats to be declared dead).  The worker must
+    stop working the unit immediately — a survivor owns it now."""
+
+
+def _read_json(path: str) -> dict | None:
+    import json
+
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        # missing, mid-replace, or torn by a dead writer: treated as
+        # absent — every writer is atomic, so this is transient
+        return None
+
+
+class WorkQueue:
+    """One host's handle on the shared lease queue.
+
+    `owner` must be unique per live process chain (the fleet passes
+    ``host<id>``; a relaunched process REUSES its dead predecessor's
+    owner string and may re-claim its own stale lease without waiting
+    out the TTL — the predecessor is guaranteed dead by the supervisor
+    before the relaunch)."""
+
+    def __init__(self, root: str, owner: str, *,
+                 lease_ttl: float = DEFAULT_LEASE_TTL_SEC):
+        self.root = root
+        self.owner = str(owner)
+        self.lease_ttl = float(lease_ttl)
+        self._leases = os.path.join(root, "leases")
+        self._done = os.path.join(root, "done")
+        self._hosts = os.path.join(root, "hosts")
+        for d in (self._leases, self._done, self._hosts):
+            os.makedirs(d, exist_ok=True)
+        #: units THIS host reclaimed from a dead owner (session-local;
+        #: the global view comes from the done markers' attempt counts)
+        self.reclaimed_units: list[str] = []
+
+    # -- paths ---------------------------------------------------------
+    def _lease_path(self, unit: str) -> str:
+        return os.path.join(self._leases, f"{_safe(unit)}.json")
+
+    def _done_path(self, unit: str) -> str:
+        return os.path.join(self._done, f"{_safe(unit)}.json")
+
+    def _host_path(self, owner: str) -> str:
+        return os.path.join(self._hosts, f"{_safe(owner)}.json")
+
+    # -- host heartbeat ------------------------------------------------
+    def beat_host(self, extra: dict | None = None) -> None:
+        """Write this host's liveness beat (fleet wedge detector +
+        degraded accounting read it)."""
+        from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+        rec = {"owner": self.owner, "heartbeat": time.time(),
+               "pid": os.getpid()}
+        if extra:
+            rec.update(extra)
+        write_json_atomic(self._host_path(self.owner), rec)
+
+    def mark_host_done(self, info: dict | None = None) -> None:
+        """Terminal host beat: a host that said ``done`` and then goes
+        quiet is finished, not lost."""
+        self.beat_host(dict(info or {}, done=True))
+
+    # -- lease lifecycle -----------------------------------------------
+    def claim(self, unit: str) -> bool:
+        """Try to take ownership of `unit`.  True = this host owns it
+        (fresh claim, its own prior lease, or a stale-lease reclaim);
+        False = done already, or another host holds a live lease."""
+        if self.is_done(unit):
+            return False
+        path = self._lease_path(unit)
+        lease = _read_json(path)
+        if lease is None:
+            return self._claim_fresh(unit, attempt=1)
+        if lease.get("owner") == self.owner:
+            # our own lease (a relaunch of this owner resuming its
+            # unit): refresh the heartbeat and carry on
+            self._write_lease(unit, attempt=int(lease.get("attempt", 1)),
+                              reclaimed_from=lease.get("reclaimed_from"))
+            return True
+        age = time.time() - float(lease.get("heartbeat", 0.0))
+        if age <= self.lease_ttl:
+            return False  # live elsewhere
+        # stale: steal under a fence FILE (exactly one linker wins) so
+        # the lease path itself never disappears — a remove-then-
+        # recreate window would let a racing fresh claim land with
+        # attempt=1 and silently drop the reclaim provenance
+        if not self._win_steal_fence(unit):
+            return False
+        fence = self._lease_path(unit) + ".steal"
+        try:
+            current = _read_json(path)
+            if current is None or \
+                    current.get("owner") != lease.get("owner") or \
+                    current.get("heartbeat") != lease.get("heartbeat"):
+                # renewed/released/re-stolen while we raced: not stale
+                return False
+            dead_owner = lease.get("owner", "?")
+            attempt = int(lease.get("attempt", 1)) + 1
+            logger.warning(
+                "workqueue: RECLAIMING unit %r from %r (lease %.1fs "
+                "stale, ttl %.1fs) — attempt %d", unit, dead_owner, age,
+                self.lease_ttl, attempt)
+            # in-place replace: no absence window for fresh claims
+            self._write_lease(unit, attempt=attempt,
+                              reclaimed_from=dead_owner)
+            self.reclaimed_units.append(unit)
+            return True
+        finally:
+            try:
+                os.remove(fence)
+            except OSError as e:
+                logger.warning("workqueue: fence cleanup failed (%s)", e)
+
+    def _win_steal_fence(self, unit: str) -> bool:
+        """Atomically take the per-unit steal fence (``<lease>.steal``).
+        A fence left by a stealer that died mid-steal unblocks after
+        its own TTL."""
+        from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+        fence = self._lease_path(unit) + ".steal"
+        stale = _read_json(fence)
+        if stale is not None and \
+                time.time() - float(stale.get("at", 0.0)) > self.lease_ttl:
+            try:
+                os.remove(fence)  # dead stealer's leftover
+            except OSError as e:
+                logger.warning("workqueue: stale fence cleanup failed (%s)", e)
+        tmp = fence + f".{_safe(self.owner)}.{os.getpid()}"
+        write_json_atomic(tmp, {"owner": self.owner, "at": time.time()})
+        try:
+            os.link(tmp, fence)
+            return True
+        except FileExistsError:
+            return False
+        except OSError as e:
+            logger.warning("workqueue: steal fence failed for %r (%s)",
+                           unit, e)
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError as e:
+                logger.warning("workqueue: fence tmp cleanup failed (%s)", e)
+
+    def _claim_fresh(self, unit: str, attempt: int,
+                     reclaimed_from: str | None = None) -> bool:
+        from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+        path = self._lease_path(unit)
+        tmp = path + f".claim.{_safe(self.owner)}.{os.getpid()}"
+        write_json_atomic(tmp, self._lease_record(unit, attempt,
+                                                  reclaimed_from))
+        try:
+            os.link(tmp, path)  # atomic test-and-set
+            return True
+        except FileExistsError:
+            return False
+        except OSError as e:
+            logger.warning("workqueue: claim link failed for %r (%s)",
+                           unit, e)
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError as e:
+                logger.warning("workqueue: claim tmp cleanup failed (%s)", e)
+
+    def _lease_record(self, unit: str, attempt: int,
+                      reclaimed_from: str | None) -> dict:
+        rec = {"unit": unit, "owner": self.owner, "attempt": int(attempt),
+               "heartbeat": time.time(), "claimed_at": time.time()}
+        if reclaimed_from:
+            rec["reclaimed_from"] = reclaimed_from
+        return rec
+
+    def _write_lease(self, unit: str, attempt: int,
+                     reclaimed_from: str | None = None) -> None:
+        from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+        write_json_atomic(self._lease_path(unit),
+                          self._lease_record(unit, attempt, reclaimed_from))
+
+    def renew(self, unit: str) -> None:
+        """Heartbeat the lease (called at dispatch/round boundaries).
+        Raises :class:`LeaseLostError` when another host reclaimed the
+        unit — the caller must abandon it."""
+        fi = faultinject.active_plan()
+        if fi is not None and fi.lease_stale(unit):
+            return  # injected wedged-heartbeat: silently drop the beat
+        lease = _read_json(self._lease_path(unit))
+        if lease is None or lease.get("owner") != self.owner:
+            raise LeaseLostError(
+                f"lease on {unit!r} is {'gone' if lease is None else 'owned by ' + repr(lease.get('owner'))}"
+                f" — this host was declared dead and the unit reclaimed")
+        self._write_lease(unit, attempt=int(lease.get("attempt", 1)),
+                          reclaimed_from=lease.get("reclaimed_from"))
+
+    def release(self, unit: str, info: dict | None = None) -> None:
+        """Mark `unit` complete (atomic done marker) and drop the
+        lease.  Idempotent; the done marker records the final owner and
+        attempt count — the global reclaim evidence."""
+        from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+        lease = _read_json(self._lease_path(unit)) or {}
+        rec = {"unit": unit, "owner": self.owner,
+               "attempt": int(lease.get("attempt", 1)),
+               "completed_at": time.time()}
+        if lease.get("reclaimed_from"):
+            rec["reclaimed_from"] = lease["reclaimed_from"]
+        if info:
+            rec["info"] = info
+        write_json_atomic(self._done_path(unit), rec)
+        if lease.get("owner") == self.owner:
+            try:
+                os.remove(self._lease_path(unit))
+            except OSError as e:
+                logger.warning("workqueue: lease cleanup failed for %r (%s)",
+                               unit, e)
+
+    # -- read side -----------------------------------------------------
+    def is_done(self, unit: str) -> bool:
+        return _read_json(self._done_path(unit)) is not None
+
+    def done_info(self, unit: str) -> dict | None:
+        """The completion marker's ``info`` payload (gate exclusions,
+        baselines — whatever the finishing host stamped), or None."""
+        rec = _read_json(self._done_path(unit))
+        return None if rec is None else rec.get("info") or {}
+
+    def read_lease(self, unit: str) -> dict | None:
+        return _read_json(self._lease_path(unit))
+
+    def known_hosts(self) -> dict[str, dict]:
+        out = {}
+        try:
+            names = sorted(os.listdir(self._hosts))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            rec = _read_json(os.path.join(self._hosts, name))
+            if rec and rec.get("owner"):
+                out[rec["owner"]] = rec
+        return out
+
+    def lost_hosts(self) -> list[str]:
+        """Hosts whose beat went stale WITHOUT a terminal done beat.
+        The caller itself is excluded — a host computing the census is
+        self-evidently alive, however long its last compile gap was."""
+        now = time.time()
+        return sorted(
+            owner for owner, rec in self.known_hosts().items()
+            if owner != self.owner and not rec.get("done")
+            and now - float(rec.get("heartbeat", 0.0)) > self.lease_ttl)
+
+    def accounting(self) -> dict:
+        """The degraded-mode stamp for ``search_result.json``: global
+        reclaim evidence (done markers with attempt > 1) + host
+        census.  Any surviving host computes the same answer."""
+        reclaimed = []
+        try:
+            names = sorted(os.listdir(self._done))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            rec = _read_json(os.path.join(self._done, name))
+            if rec and int(rec.get("attempt", 1)) > 1:
+                reclaimed.append({
+                    "unit": rec.get("unit", name[:-5]),
+                    "attempt": rec["attempt"],
+                    "finished_by": rec.get("owner"),
+                    "reclaimed_from": rec.get("reclaimed_from")})
+        lost = self.lost_hosts()
+        return {
+            "degraded": bool(reclaimed or lost),
+            "lost_hosts": lost,
+            "reclaimed_units": reclaimed,
+            "num_reclaimed_units": len(reclaimed),
+        }
+
+
+def _safe(name: str) -> str:
+    """Unit/owner id -> filename (no separators/parent escapes)."""
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in str(name))

@@ -47,7 +47,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from fast_autoaugment_tpu.core.checkpoint import load_checkpoint, read_metadata
-from fast_autoaugment_tpu.core.resilience import PreemptedError
+from fast_autoaugment_tpu.core.resilience import (
+    DispatchHungError,
+    PreemptedError,
+)
+from fast_autoaugment_tpu.core.watchdog import resolve_watchdog
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.models import get_model, num_class
 from fast_autoaugment_tpu.ops.augment import SEARCH_OP_NAMES
@@ -248,7 +252,7 @@ class _FoldEval:
 
     def __init__(self, conf, dataroot, mesh, *, num_policy, num_op, cv_ratio,
                  seed, trial_batch: int = 1, aug_dispatch: str = "exact",
-                 aug_groups: int = 8):
+                 aug_groups: int = 8, watchdog=None):
         from fast_autoaugment_tpu.ops.augment import check_aug_dispatch
 
         self.conf, self.dataroot, self.mesh = conf, dataroot, mesh
@@ -257,6 +261,7 @@ class _FoldEval:
         self.trial_batch = max(1, int(trial_batch))
         self.aug_dispatch = check_aug_dispatch(aug_dispatch)
         self.aug_groups = max(1, int(aug_groups))
+        self.watchdog = resolve_watchdog(watchdog)
         self._built = False
         self._batches: dict[int, Callable] = {}
         # distinct leading policy-tensor shapes fed to the compiled TTA
@@ -386,9 +391,18 @@ class _FoldEval:
         self._batches[fold] = fn
         return fn
 
+    def _guarded(self, label: str, fn, *args):
+        """TTA/audit evaluations through the watchdog seam (one
+        monitored window per whole-fold evaluation; the per-label EMA
+        tracks the full replay wall).  Off = the direct call."""
+        if not self.watchdog.enabled:
+            return fn(*args)
+        return self.watchdog.run(label, fn, *args)
+
     def evaluate(self, fold: int, params, batch_stats, policy_t, key) -> dict:
         self.policy_shapes.add(int(policy_t.shape[0]))
-        return eval_tta(
+        return self._guarded(
+            "tta", eval_tta,
             self.tta_step, params, batch_stats, self.batches_fn(fold)(),
             policy_t, key,
         )
@@ -407,7 +421,8 @@ class _FoldEval:
                 f"candidate axis {int(policies_t.shape[0])} != compiled "
                 f"trial_batch {self.trial_batch}")
         self.batch_policy_shapes.add(int(policies_t.shape[0]))
-        return eval_tta_batched(
+        return self._guarded(
+            "tta_batched", eval_tta_batched,
             self.tta_step_batch, params, batch_stats,
             self.batches_fn(fold)(), policies_t, keys,
         )
@@ -416,8 +431,9 @@ class _FoldEval:
         """Batched audit: S sub-policies against one mesh-placed batch
         in a single compiled call (``make_audit_step``)."""
         self._build()
-        return self.audit_step(params, batch_stats, batch["x"], batch["y"],
-                               batch["m"], subs, key)
+        return self._guarded(
+            "audit", self.audit_step, params, batch_stats, batch["x"],
+            batch["y"], batch["m"], subs, key)
 
     def baseline(self, fold: int, path: str) -> float:
         """No-candidate-policy fold accuracy: the identity policy (one
@@ -462,6 +478,8 @@ def search_policies(
     steps_per_dispatch: int = 1,
     divergence_retries: int = 0,
     ckpt_keep: int = 2,
+    watchdog="off",
+    work_queue=None,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -551,6 +569,27 @@ def search_policies(
     (:class:`PreemptedError`) always propagates: per-fold checkpoints
     and the per-trial log make the rerun resume where it stopped.
 
+    `watchdog` ("off" default / "auto" / seconds) deadline-guards every
+    device dispatch this search issues — phase-1 train dispatches, TTA
+    evaluations, the audit — raising the typed ``DispatchHungError``
+    (exit-77 process-restart recovery) when one wedges; fire counts
+    and per-label deadlines are stamped into
+    ``search_result.json['resilience']['watchdog']``.
+
+    `work_queue` (a :class:`~fast_autoaugment_tpu.launch.workqueue.
+    WorkQueue` over a shared directory, or None) makes the multi-host
+    scatter ELASTIC: instead of the static ``--folds`` assignment,
+    hosts claim phase-1 fold trainings (with their gate retrains) and
+    per-fold phase-2 trial searches off a lease queue, renew the lease
+    at dispatch/round boundaries, and RECLAIM units whose lease went
+    stale — a dead host's fold is finished by a survivor from the PR-5
+    checkpoint chain + per-fold trial log, and the search completes
+    with any >= 1 live host.  Trial logs are per-fold files
+    (``search_trials.fold<k>.json``) in this mode so concurrent hosts
+    never clobber one shared file; the accounting (``degraded``,
+    ``lost_hosts``, ``reclaimed_units``) is stamped into the result.
+    Fold stacking is forced off (work units are per fold).
+
     PHASE ordering stays sequential (VERDICT round 1, next-step 9):
     phase-1 fold training and phase-2 TTA evaluation are both
     device-bound on the same chip, so overlapping PHASES cannot shorten
@@ -599,16 +638,28 @@ def search_policies(
         with open(trials_path) as fh:
             trials_log = json.load(fh)
 
+    def _fold_trials_path(fold: int) -> str:
+        """Per-fold trial log (work-queue mode): one writer per lease,
+        so concurrent hosts can never clobber each other's folds."""
+        return os.path.join(save_dir, f"search_trials.fold{fold}.json")
+
+    def _load_fold_trials(fold: int) -> list:
+        if work_queue is not None and os.path.exists(_fold_trials_path(fold)):
+            with open(_fold_trials_path(fold)) as fh:
+                return json.load(fh)
+        return trials_log.get(str(fold), [])
+
     def _fold_searched(fold: int) -> bool:
-        return len(trials_log.get(str(fold), [])) >= num_search
+        return len(_load_fold_trials(fold)) >= num_search
 
     trial_batch = max(1, int(trial_batch))
     result["trial_batch"] = trial_batch
+    wd = resolve_watchdog(watchdog)
     evaluator = _FoldEval(
         conf, dataroot, mesh,
         num_policy=num_policy, num_op=num_op, cv_ratio=cv_ratio, seed=seed,
         trial_batch=trial_batch, aug_dispatch=aug_dispatch,
-        aug_groups=aug_groups,
+        aug_groups=aug_groups, watchdog=wd,
     )
     # dispatch-mode stamping: the artifact must say which augmentation
     # kernel scored these trials (grouped deviates distributionally)
@@ -622,16 +673,29 @@ def search_policies(
     divergence_retries = max(0, int(divergence_retries))
     ckpt_keep = max(1, int(ckpt_keep))
     result["resilience"] = {"divergence_retries": divergence_retries,
-                            "ckpt_keep": ckpt_keep}
+                            "ckpt_keep": ckpt_keep,
+                            "watchdog": wd.stats()}
     # quarantined phase-2 trials (TTA evaluation raised): recorded, told
     # to TPE as the worst observed reward, never ranked
     quarantined: list[dict] = []
     # shared by the sequential trainer AND the fold stack; the
-    # divergence-retry knob is sequential-only (train_and_eval)
+    # divergence-retry knob is sequential-only (train_and_eval); the
+    # ONE watchdog instance threads through so fire counts aggregate
     train_feed_kw = dict(device_cache=device_cache,
                          steps_per_dispatch=steps_per_dispatch,
-                         ckpt_keep=ckpt_keep)
+                         ckpt_keep=ckpt_keep, watchdog=wd)
     seq_train_kw = dict(train_feed_kw, divergence_retries=divergence_retries)
+
+    def _lease_heartbeat(unit: str):
+        """Dispatch-boundary callback for the trainer / trial loop:
+        renew the unit's lease + this host's liveness beat."""
+        if work_queue is None:
+            return None
+
+        def beat():
+            work_queue.renew(unit)
+            work_queue.beat_host()
+        return beat
     fold_baselines: dict[int, float] = {}
     excluded_folds: list[int] = []
 
@@ -660,6 +724,12 @@ def search_policies(
     stack_trained: set[int] = set()
     pending = [f for f in fold_list
                if not _fold_searched(f) and _needs_training(f)]
+    if work_queue is not None and fold_stack not in (None, 0, "0"):
+        # lease units are per fold: a stacked group would advance folds
+        # this host does not own
+        logger.warning("workqueue: fold stacking forced off — work "
+                       "units are per fold")
+        fold_stack = 0
     stack_k = resolve_fold_stack(fold_stack, len(pending))
     if stack_k and train_fold_fn is not None:
         logger.warning(
@@ -690,10 +760,12 @@ def search_policies(
                 phase1_attr[f] += g_secs / len(group)
             stack_trained.update(group)
 
-    for fold in range(cv_num):
+    def _phase1_fold(fold: int, heartbeat=None) -> None:
+        """The full per-fold phase-1 body: train if needed, then the
+        fold-oracle quality gate (+fresh-seed retrains).  `heartbeat`
+        (work-queue mode) renews the fold's lease at every trainer
+        dispatch boundary."""
         path = fold_paths[fold]
-        if fold not in fold_list:
-            continue
         if _fold_searched(fold):
             # merged trial state from another host: nothing left to train,
             # but the quality gate still applies — a resumed weak oracle
@@ -717,7 +789,7 @@ def search_policies(
                         "checkpoint is not on this host — quality gate "
                         "cannot assess it; trials rank ungated", fold,
                     )
-            continue
+            return
         meta = read_metadata(path)
         if fold in stack_trained:
             logger.info("phase1: fold %d trained in the stacked program", fold)
@@ -731,7 +803,7 @@ def search_policies(
                     no_aug_conf, dataroot,
                     test_ratio=cv_ratio, cv_fold=fold,
                     save_path=path, metric="last", seed=seed,
-                    **seq_train_kw,
+                    heartbeat=heartbeat, **seq_train_kw,
                 )
             phase1_attr[fold] += (time.time() - t_f) * mesh.size
         else:
@@ -741,7 +813,7 @@ def search_policies(
         # of 0.37-0.65 produced a reward signal that ranked destructive
         # policies on top)
         if fold_quality_floor is None:
-            continue
+            return
         acc = evaluator.baseline(fold, path)
         tries = 0
         while acc < fold_quality_floor and tries < fold_retrain_tries:
@@ -767,7 +839,7 @@ def search_policies(
                 train_and_eval(
                     no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
                     save_path=alt, metric="last", seed=retry_seed,
-                    **seq_train_kw,
+                    heartbeat=heartbeat, **seq_train_kw,
                 )
             phase1_attr[fold] += (time.time() - t_r) * mesh.size
             alt_acc = evaluator.baseline(fold, alt)
@@ -787,6 +859,67 @@ def search_policies(
         else:
             logger.info("phase1: fold %d baseline %.3f (floor %.3f) ok",
                         fold, acc, fold_quality_floor)
+
+    def _workqueue_phase(units: dict[int, str], run) -> None:
+        """Claim-and-run `units` ({fold: unit_id}) until EVERY unit is
+        done (by this host or any other).  Passes that find nothing
+        claimable wait out a fraction of the TTL — a stale lease (dead
+        or wedged owner) is then reclaimed and the unit finished here,
+        resuming from the shared checkpoint chain / trial log.  A
+        LeaseLostError mid-work abandons the unit to its new owner
+        (this host was presumed dead; its writes stay safe — same
+        seeds, same atomic chain)."""
+        from fast_autoaugment_tpu.launch.workqueue import LeaseLostError
+
+        pending = dict(units)
+        while pending:
+            progress = False
+            for fold, unit in sorted(pending.items()):
+                if work_queue.is_done(unit):
+                    del pending[fold]
+                    progress = True
+                    continue
+                if not work_queue.claim(unit):
+                    continue
+                work_queue.beat_host()
+                try:
+                    info = run(fold, unit)
+                except LeaseLostError as e:
+                    logger.warning(
+                        "workqueue: lost the lease on %s mid-work (%s) — "
+                        "abandoning it to its new owner", unit, e)
+                    continue
+                work_queue.release(unit, info=info)
+                del pending[fold]
+                progress = True
+            if pending and not progress:
+                work_queue.beat_host()
+                time.sleep(max(0.2, min(5.0, work_queue.lease_ttl / 4.0)))
+        work_queue.beat_host()
+
+    if work_queue is None:
+        for fold in range(cv_num):
+            if fold not in fold_list:
+                continue
+            _phase1_fold(fold)
+    else:
+        work_queue.beat_host()
+
+        def _run_p1(fold, unit):
+            _phase1_fold(fold, heartbeat=_lease_heartbeat(unit))
+            return {"baseline": fold_baselines.get(fold),
+                    "excluded": fold in excluded_folds}
+
+        _workqueue_phase({f: f"p1-fold{f}" for f in fold_list}, _run_p1)
+        # folds finished by other hosts: adopt their gate verdicts from
+        # the done markers (the ranking below must honor every
+        # exclusion, wherever the gate ran)
+        for fold in fold_list:
+            info = work_queue.done_info(f"p1-fold{fold}") or {}
+            if info.get("baseline") is not None and fold not in fold_baselines:
+                fold_baselines[fold] = float(info["baseline"])
+            if info.get("excluded") and fold not in excluded_folds:
+                excluded_folds.append(fold)
     # device_secs_* is the honest name; tpu_secs_* stays as a
     # compatibility alias for committed-artifact readers (same value)
     result["device_secs_phase1"] = result["tpu_secs_phase1"] = (
@@ -810,13 +943,17 @@ def search_policies(
     space = make_search_space(num_policy, num_op)
     final_policy_set = []
 
-    for fold in fold_list:
+    def _phase2_fold(fold: int, heartbeat=None) -> dict | None:
+        """One fold's full TPE trial budget (sequential or batched
+        scheduler).  `heartbeat` (work-queue mode) renews the fold's
+        lease after every persisted trial/round."""
         if fold in excluded_folds:
             logger.info("phase2: fold %d excluded by the quality gate", fold)
-            continue
+            return None
         if _fold_searched(fold):
             logger.info("phase2: fold %d trials already complete", fold)
-            continue
+            trials_log[str(fold)] = _load_fold_trials(fold)
+            return None
         params, batch_stats = evaluator.load_fold(fold_paths[fold])
 
         # small budgets keep some TPE engagement: the hyperopt default
@@ -825,10 +962,20 @@ def search_policies(
         tpe = TPE(space, seed=seed * 1000 + fold,
                   n_startup=min(20, max(5, num_search // 4)))
         key_fold = jax.random.PRNGKey(seed * 77 + fold)
-        fold_trials = trials_log.get(str(fold), [])
+        fold_trials = _load_fold_trials(fold)
         for entry in fold_trials:  # resume previous trials (a third
             # element marks a quarantined trial's failure record)
             tpe.tell(entry[0], entry[1])
+
+        def _persist_trials():
+            trials_log[str(fold)] = fold_trials
+            if work_queue is not None:
+                # one writer per lease: the fold file, not the shared log
+                _write_json_atomic(_fold_trials_path(fold), fold_trials)
+            else:
+                _write_json_atomic(trials_path, trials_log)
+            if heartbeat is not None:
+                heartbeat()
 
         def _quarantine(trial_lo: int, trial_hi: int, exc: BaseException,
                         fold=fold) -> float:
@@ -872,8 +1019,12 @@ def search_policies(
                     jax.random.fold_in(key_fold, trial_idx),
                 )
                 reward = metrics["top1_valid"]
-            except PreemptedError:
-                raise  # graceful shutdown is NOT a trial failure
+            except (PreemptedError, DispatchHungError):
+                # graceful shutdown is NOT a trial failure, and a hung
+                # dispatch means the backend is wedged — quarantining it
+                # would keep dispatching into the wedge; both take the
+                # exit-77 restart path
+                raise
             except (ArithmeticError, RuntimeError, ValueError, OSError) as e:
                 reward = _quarantine(trial_idx, trial_idx + 1, e)
                 failure = {"quarantined": True,
@@ -890,9 +1041,9 @@ def search_policies(
             # persist EVERY trial (fsync + atomic rename): a crash loses
             # at most the in-flight evaluation (VERDICT r3, weak 4); the
             # JSON is small and the write is trivially cheap next to a
-            # compiled TTA evaluation
-            trials_log[str(fold)] = fold_trials
-            _write_json_atomic(trials_path, trials_log)
+            # compiled TTA evaluation.  Trial persistence is also the
+            # lease-renewal boundary in work-queue mode.
+            _persist_trials()
             if trial_idx % 10 == 0 or trial_idx == num_search - 1:
                 logger.info(
                     "phase2 fold %d trial %d/%d: top1_valid=%.4f best=%.4f",
@@ -931,8 +1082,8 @@ def search_policies(
                 metrics_list = evaluator.evaluate_batch(
                     fold, params, batch_stats, policies_t, keys)[:k_eff]
                 rewards = [m["top1_valid"] for m in metrics_list]
-            except PreemptedError:
-                raise
+            except (PreemptedError, DispatchHungError):
+                raise  # shutdown / wedged backend: restart, not quarantine
             except (ArithmeticError, RuntimeError, ValueError, OSError) as e:
                 # one vmapped program evaluates the whole round: a raise
                 # cannot be attributed to a single candidate, so the
@@ -949,14 +1100,31 @@ def search_policies(
             fold_trials.extend(
                 (p, r) if round_failure is None else (p, r, round_failure)
                 for p, r in zip(proposals, rewards))
-            trials_log[str(fold)] = fold_trials
-            _write_json_atomic(trials_path, trials_log)
+            _persist_trials()
             logger.info(
                 "phase2 fold %d trials %d-%d/%d (batch of %d): "
                 "best_in_batch=%.4f best=%.4f",
                 fold, t_base, t_base + k_eff - 1, num_search, k_eff,
                 max(rewards), tpe.best[1],
             )
+        return {"num_trials": len(fold_trials)}
+
+    if work_queue is None:
+        for fold in fold_list:
+            _phase2_fold(fold)
+    else:
+        def _run_p2(fold, unit):
+            return _phase2_fold(fold, heartbeat=_lease_heartbeat(unit)) or {}
+
+        _workqueue_phase(
+            {f: f"p2-fold{f}" for f in fold_list if f not in excluded_folds},
+            _run_p2)
+        # every fold's trials (finished here or by other hosts) merge
+        # into the in-memory log so the ranking below sees all of them
+        for fold in fold_list:
+            ft = _load_fold_trials(fold)
+            if ft:
+                trials_log[str(fold)] = ft
 
     # top-N per fold from the trial log (covers folds run here, folds
     # merged from other hosts, and folds resumed from disk alike,
@@ -1056,6 +1224,7 @@ def search_policies(
         # write the run would leave no search_result.json to diagnose
         # or resume from (ADVICE r5, driver.py:682)
         result["failure"] = {"stage": "tta_executable_census", "error": msg}
+        result["resilience"]["watchdog"] = wd.stats()
         result["final_policy_set_pre_audit_size"] = len(final_policy_set)
         result["elapsed_total"] = time.time() - watch["start"]
         _write_json_atomic(
@@ -1129,6 +1298,26 @@ def search_policies(
         result["num_sub_policies_random"] = len(random_set)
         _write_json_atomic(os.path.join(save_dir, "random_final_policy.json"),
                            random_set)
+
+    # self-healing accounting, refreshed AFTER all device work so the
+    # stamps cover the whole run: watchdog fire counts + (work-queue
+    # mode) the degraded-completion evidence any surviving host can
+    # reconstruct from the shared queue state
+    result["resilience"]["watchdog"] = wd.stats()
+    result["watchdog_fires"] = wd.fires
+    if work_queue is not None:
+        work_queue.beat_host()  # the census must not see a stale self
+        acct = work_queue.accounting()
+        result["resilience"]["fleet"] = acct
+        result["degraded"] = acct["degraded"]
+        result["lost_hosts"] = acct["lost_hosts"]
+        result["reclaimed_units"] = [r["unit"]
+                                     for r in acct["reclaimed_units"]]
+        if acct["degraded"]:
+            logger.warning(
+                "search completed DEGRADED: lost_hosts=%s, %d unit(s) "
+                "reclaimed and finished by survivors",
+                acct["lost_hosts"], acct["num_reclaimed_units"])
 
     result["final_policy_set"] = final_policy_set
     result["num_sub_policies"] = len(final_policy_set)

@@ -38,6 +38,7 @@ from fast_autoaugment_tpu.core.resilience import (
     install_signal_handlers,
     preemption_requested,
 )
+from fast_autoaugment_tpu.core.watchdog import resolve_watchdog
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.data.pipeline import (
     BatchIterator,
@@ -145,12 +146,51 @@ def _stacked_eval_splits(it: BatchIterator, global_batch: int, mesh,
     return out
 
 
-def _run_replay_eval(replay_step, params, batch_stats, groups) -> dict:
-    """One fused dispatch per shape group over a replayed split."""
+def _run_replay_eval(replay_step, params, batch_stats, groups,
+                     wd=None) -> dict:
+    """One fused dispatch per shape group over a replayed split (each
+    deadline-guarded when a watchdog is enabled — the PR-4 rendezvous
+    deadlock was first observed exactly here, in eval)."""
     acc = Accumulator()
     for g in groups:
-        acc.add_dict(replay_step(params, batch_stats, g["x"], g["y"], g["m"]))
+        if wd is not None and wd.enabled:
+            out = wd.run("replay_eval", replay_step, params, batch_stats,
+                         g["x"], g["y"], g["m"])
+        else:
+            out = replay_step(params, batch_stats, g["x"], g["y"], g["m"])
+        acc.add_dict(out)
     return acc.normalize()
+
+
+def _monitored_dispatch(wd, label: str, fi, step: int, fn, *args):
+    """One device dispatch through the watchdog seam.
+
+    With the watchdog off and no injected fault this is EXACTLY the
+    historical direct call — async dispatch, no per-dispatch block.
+    With the watchdog on (or a ``hang``/``slow`` fault pinned at this
+    step) the call runs deadline-guarded in a worker thread, blocking
+    on completion; that serializes the dispatch pipeline (wall only —
+    values are unchanged), which is why ``--watchdog`` defaults off.
+    A fired deadline raises the typed ``DispatchHungError`` (exit-77
+    recovery — core/watchdog.py)."""
+    inject = fi.dispatch_delay(step) if fi is not None else None
+    if inject is None and not wd.enabled:
+        return fn(*args)
+    delay = 0.0
+    if inject is not None:
+        kind, val = inject
+        # slow = straggler at F x the label's observed EMA (F seconds
+        # before any observation); hang = forever
+        delay = val if kind == "hang" else val * (wd.ema(label) or 1.0)
+    return wd.run(label, fn, *args, inject_delay=delay)
+
+
+def _beat(heartbeat) -> None:
+    """Lease/host heartbeat at a safe boundary.  LeaseLostError (the
+    unit was reclaimed — launch/workqueue.py) propagates: this worker
+    must abandon the unit, not finish and clobber the survivor."""
+    if heartbeat is not None:
+        heartbeat()
 
 
 def _sum_metric_dicts(metric_dicts: list) -> dict:
@@ -193,6 +233,8 @@ def train_and_eval(
     divergence_retries: int = 0,
     ckpt_keep: int = 2,
     checkpoint_every_dispatch: int = 0,
+    watchdog="off",
+    heartbeat: Callable | None = None,
 ) -> dict:
     """Train (or just evaluate) one model under `conf`.
 
@@ -231,6 +273,15 @@ def train_and_eval(
     …).  ``checkpoint_every_dispatch`` (M, cache path only) adds a
     mid-epoch snapshot every M dispatches — resumable from the exact
     dispatch boundary, bit-identically.
+
+    ``watchdog`` ("off" default / "auto" / seconds, or a shared
+    :class:`~fast_autoaugment_tpu.core.watchdog.DispatchWatchdog`)
+    deadline-guards every train dispatch and eval replay; a wedged
+    dispatch raises the typed ``DispatchHungError`` (exit-77 restart
+    recovery) instead of blocking forever.  ``heartbeat`` (callable,
+    e.g. a work-queue lease renewal) is invoked at every dispatch-chunk
+    boundary (cache path) and epoch boundary — a raised
+    ``LeaseLostError`` propagates and aborts the unit.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -363,6 +414,7 @@ def train_and_eval(
     ckpt_keep = max(1, int(ckpt_keep))
     divergence_retries = max(0, int(divergence_retries))
     checkpoint_every_dispatch = max(0, int(checkpoint_every_dispatch))
+    wd = resolve_watchdog(watchdog)
     # flag-setting SIGTERM/SIGUSR1 handlers (idempotent, main thread
     # only): the epoch/dispatch loops below poll the flag at safe
     # boundaries — see core/resilience.py and docs/RESILIENCE.md
@@ -454,7 +506,7 @@ def train_and_eval(
                         it, global_batch, mesh, eval_kw)
                 norm = _run_replay_eval(
                     replay_eval, state.params, state.batch_stats,
-                    eval_replay[split])
+                    eval_replay[split], wd=wd)
             else:
                 norm = _run_eval(
                     eval_step, state.params, state.batch_stats,
@@ -465,7 +517,7 @@ def train_and_eval(
                 if use_cache:
                     norm_ema = _run_replay_eval(
                         replay_eval, state.ema["params"],
-                        state.ema["batch_stats"], eval_replay[split])
+                        state.ema["batch_stats"], eval_replay[split], wd=wd)
                 else:
                     norm_ema = _run_eval(
                         eval_step, state.ema["params"],
@@ -582,7 +634,10 @@ def train_and_eval(
             for di, n in enumerate(split_dispatch_chunks(
                     len(mat) - pos, steps_per_dispatch)):
                 idx_dev = place_index_matrix(mesh, mat[pos:pos + n])
-                state, metrics = get_multi_step(n)(
+                state, metrics = _monitored_dispatch(
+                    wd, "train_dispatch", fi,
+                    (epoch - 1) * steps_per_epoch + pos + n,
+                    get_multi_step(n),
                     state, train_cache.images, train_cache.labels,
                     idx_dev, pol, rng_epoch)
                 # per-dispatch sums are kept as ASYNC device handles and
@@ -593,6 +648,7 @@ def train_and_eval(
                 dispatch_metrics.append(metrics)
                 progress(di, metrics)
                 pos += n
+                _beat(heartbeat)
                 if fi is not None:
                     fi.maybe_signal((epoch - 1) * steps_per_epoch + pos)
                 # resilience boundary: the PR-4 dispatch boundaries are
@@ -638,12 +694,16 @@ def train_and_eval(
                 transform=shard_transform(mesh),
             )
             for bi, batch in enumerate(batches):
-                state, metrics = train_step(state, batch["x"], batch["y"],
-                                            pol, rng_epoch)
+                state, metrics = _monitored_dispatch(
+                    wd, "train_step", fi,
+                    (epoch - 1) * steps_per_epoch + bi + 1,
+                    train_step, state, batch["x"], batch["y"],
+                    pol, rng_epoch)
                 acc.add_dict(metrics)
                 progress(bi, metrics)
                 if fi is not None:
                     fi.maybe_signal((epoch - 1) * steps_per_epoch + bi + 1)
+        _beat(heartbeat)
         resume_pos, resume_sums = 0, None  # consumed by the first epoch
         if is_master and progress_every and loss_ema is not None:
             sys.stderr.write("\n")
@@ -795,6 +855,8 @@ def train_folds_stacked(
     device_cache: str = "auto",
     steps_per_dispatch: int = 1,
     ckpt_keep: int = 2,
+    watchdog="off",
+    heartbeat: Callable | None = None,
 ) -> dict[int, dict]:
     """Train K phase-1 fold models as ONE vmapped program per step.
 
@@ -845,7 +907,10 @@ def train_folds_stacked(
     + the mid-epoch position, resumable bit-identically) or epoch
     boundary (host path), then :class:`PreemptedError` carries the
     exit-77 contract up.  ``ckpt_keep`` bounds each fold's rollback
-    chain; restore walks to the newest intact link.
+    chain; restore walks to the newest intact link.  ``watchdog`` /
+    ``heartbeat`` follow the :func:`train_and_eval` contract
+    (deadline-guarded dispatches; lease renewal per dispatch/epoch
+    boundary).
     """
     if len(folds) != len(save_paths):
         raise ValueError(f"{len(folds)} folds but {len(save_paths)} paths")
@@ -946,6 +1011,7 @@ def train_folds_stacked(
     ) if use_cache else None
 
     ckpt_keep = max(1, int(ckpt_keep))
+    wd = resolve_watchdog(watchdog)
     install_signal_handlers()
 
     # per-fold init/restore (newest intact chain link), then one
@@ -1061,7 +1127,7 @@ def train_folds_stacked(
                         it, global_batch, mesh, eval_kw)
                 out[split] = _run_replay_eval(
                     replay_eval, state_k.params, state_k.batch_stats,
-                    eval_replay[ck])
+                    eval_replay[ck], wd=wd)
             else:
                 out[split] = _run_eval(
                     eval_step, state_k.params, state_k.batch_stats,
@@ -1123,7 +1189,10 @@ def train_folds_stacked(
                                            steps_per_dispatch):
                 idx_dev, act_dev = place_stacked_index_matrix(
                     mesh, chunks[pos:pos + n], act[pos:pos + n])
-                stacked, metrics = get_multi_step(n)(
+                stacked, metrics = _monitored_dispatch(
+                    wd, "stacked_dispatch", fi,
+                    (epoch - 1) * steps_per_epoch + pos + n,
+                    get_multi_step(n),
                     stacked, train_cache.images, train_cache.labels,
                     idx_dev, pol, keys, act_dev)
                 # async device handles, host-summed at epoch end — a
@@ -1132,6 +1201,7 @@ def train_folds_stacked(
                 # CPU backend (_sum_metric_dicts / make_replay_eval_step)
                 dispatch_metrics.append(metrics)
                 pos += n
+                _beat(heartbeat)
                 if fi is not None:
                     fi.maybe_signal((epoch - 1) * steps_per_epoch + pos)
                 if preemption_requested() and pos < len(chunks):
@@ -1170,12 +1240,16 @@ def train_folds_stacked(
             )
             for bi, batch in enumerate(batches):
                 active = batch["a"] * ep_act_dev
-                stacked, metrics = stacked_step(
+                stacked, metrics = _monitored_dispatch(
+                    wd, "stacked_step", fi,
+                    (epoch - 1) * steps_per_epoch + bi + 1,
+                    stacked_step,
                     stacked, batch["x"], batch["y"], pol, keys, active)
                 epoch_sums = metrics if epoch_sums is None else {
                     kk: epoch_sums[kk] + metrics[kk] for kk in epoch_sums}
                 if fi is not None:
                     fi.maybe_signal((epoch - 1) * steps_per_epoch + bi + 1)
+            _beat(heartbeat)
         host_sums = {kk: np.asarray(v)
                      for kk, v in (epoch_sums or {}).items()}
 

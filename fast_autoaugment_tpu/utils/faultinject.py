@@ -35,11 +35,33 @@ Kinds and their trigger coordinates:
 ``trial_error@trial=K``
     The phase-2 search raises at trial index K (per fold) — drives the
     quarantine path.
+``hang@step=K``
+    The dispatch covering global step K sleeps FOREVER inside the
+    monitored region — the wedged-rendezvous case the watchdog
+    (``core/watchdog.py``) exists to detect.  With the watchdog off
+    this wedges the process for real (the fleet heartbeat monitor is
+    then the only way out).
+``slow@step=K,factor=F``
+    The dispatch covering step K takes F x the watchdog's current EMA
+    for its label (F seconds when no EMA yet) — a straggler, not a
+    hang; distinguishes deadline tuning from hang detection.
+``stale_lease@unit=NAME``
+    Work-queue heartbeat renewals for lease unit NAME are silently
+    dropped from the first match onward (a wedged heartbeat thread) —
+    drives the stale-lease reclaim path (``launch/workqueue.py``).
 
 Each step/save/trial-pinned spec fires exactly ONCE per process (the
 counter-based kinds are consumed when hit); ``io_error`` fires per its
-Bernoulli stream.  Tests in the same process call :func:`reset` after
-mutating ``os.environ['FAA_FAULT']``.
+Bernoulli stream; ``stale_lease`` latches (every later renewal for the
+unit stays dropped).  Tests in the same process call :func:`reset`
+after mutating ``os.environ['FAA_FAULT']``.
+
+Process-chain gating: the signal/hang/slow kinds accept an optional
+``attempt=N`` key — the spec fires only when ``FAA_ATTEMPT`` (exported
+by the fleet supervisor as the per-host launch counter, default 1)
+equals N.  A relaunched process otherwise re-reads the same
+``FAA_FAULT`` and re-fires the same fault forever, which would make
+"recovers after one restart" untestable.
 """
 
 from __future__ import annotations
@@ -49,7 +71,8 @@ import random
 
 from fast_autoaugment_tpu.utils.logging import get_logger
 
-__all__ = ["FaultPlan", "active_plan", "reset", "parse_fault_spec"]
+__all__ = ["FaultPlan", "active_plan", "reset", "parse_fault_spec",
+           "current_attempt", "ATTEMPT_ENV_VAR"]
 
 logger = get_logger("faa_tpu.faultinject")
 
@@ -57,14 +80,36 @@ ENV_VAR = "FAA_FAULT"
 
 _KINDS = {
     "nan_loss": ("step",),
-    "sigterm": ("step",),
-    "sigusr1": ("step",),
-    "sigkill": ("step",),
+    "sigterm": ("step", "attempt"),
+    "sigusr1": ("step", "attempt"),
+    "sigkill": ("step", "attempt"),
     "torn_ckpt": ("save",),
     "corrupt_ckpt": ("save",),
     "io_error": ("p", "seed"),
     "trial_error": ("trial",),
+    "hang": ("step", "attempt"),
+    "slow": ("step", "factor", "attempt"),
+    "stale_lease": ("unit",),
 }
+
+# keys that are optional for their kind (everything else is required)
+_OPTIONAL_KEYS = {"attempt"}
+# value parsers: default int
+_FLOAT_KEYS = {"p", "factor"}
+_STR_KEYS = {"unit"}
+
+#: env var carrying the per-host launch counter (fleet exports it on
+#: every (re)launch; absent = attempt 1)
+ATTEMPT_ENV_VAR = "FAA_ATTEMPT"
+
+
+def current_attempt() -> int:
+    try:
+        return int(os.environ.get(ATTEMPT_ENV_VAR, "1") or 1)
+    except ValueError:
+        logger.warning("%s=%r is not an integer — treating as attempt 1",
+                       ATTEMPT_ENV_VAR, os.environ.get(ATTEMPT_ENV_VAR))
+        return 1
 
 
 def parse_fault_spec(spec: str) -> list[dict]:
@@ -99,8 +144,17 @@ def parse_fault_spec(spec: str) -> list[dict]:
             if key not in _KINDS[kind]:
                 raise ValueError(
                     f"fault {kind!r} takes keys {_KINDS[kind]}, got {key!r}")
-            args[key] = float(val) if key == "p" else int(val)
-        required = {"io_error": ("p",)}.get(kind, _KINDS[kind])
+            if key in _FLOAT_KEYS:
+                args[key] = float(val)
+            elif key in _STR_KEYS:
+                val = val.strip()
+                if not val:
+                    raise ValueError(f"fault {kind!r} key {key!r} is empty")
+                args[key] = val
+            else:
+                args[key] = int(val)
+        required = {"io_error": ("p",)}.get(
+            kind, tuple(k for k in _KINDS[kind] if k not in _OPTIONAL_KEYS))
         missing = [k for k in required if k not in args]
         if missing:
             raise ValueError(f"fault {kind!r} missing keys {missing}")
@@ -131,6 +185,8 @@ class FaultPlan:
         for f in self.faults:
             if f["kind"] != kind or f["fired"]:
                 continue
+            if "attempt" in f and current_attempt() != f["attempt"]:
+                continue  # gated to a different process-chain attempt
             hit = value >= f[key] if at_least else value == f[key]
             if hit:
                 f["fired"] = True
@@ -175,6 +231,33 @@ class FaultPlan:
 
     def trial_error_at(self, trial: int) -> bool:
         return self._take("trial_error", "trial", trial) is not None
+
+    def dispatch_delay(self, step: int) -> tuple[str, float] | None:
+        """Consult the hang/slow verbs at the dispatch seam (with the
+        step the dispatch will reach).  Returns ``("hang", inf)``,
+        ``("slow", factor)``, or None.  The caller (the watchdog seam)
+        turns "slow" into ``factor x EMA`` seconds."""
+        if self._take("hang", "step", step, at_least=True):
+            return ("hang", float("inf"))
+        f = self._take("slow", "step", step, at_least=True)
+        if f is not None:
+            return ("slow", float(f["factor"]))
+        return None
+
+    def lease_stale(self, unit: str) -> bool:
+        """True when heartbeat renewals for `unit` must be dropped.
+        LATCHES: after the first match every later renewal for the unit
+        stays dropped (a wedged heartbeat thread never comes back)."""
+        for f in self.faults:
+            if f["kind"] != "stale_lease" or f["unit"] != unit:
+                continue
+            if not f["fired"]:
+                f["fired"] = True
+                logger.warning(
+                    "faultinject: dropping heartbeats for lease unit %r "
+                    "from now on (stale_lease)", unit)
+            return True
+        return False
 
     def io_error_now(self) -> bool:
         """Seeded Bernoulli draw per consult (checkpoint/metadata reads)."""

@@ -90,6 +90,70 @@ def test_robust_allow_suppression():
     assert not check_source(src, OUT_SCOPE)
 
 
+BLOCK_SCOPE = "fast_autoaugment_tpu/launch/x.py"
+TRAIN_SCOPE = "fast_autoaugment_tpu/train/x.py"  # R3 yes, R4 no
+
+
+def test_untimed_thread_join_flagged():
+    src = ("import threading\n"
+           "t = threading.Thread(target=f)\n"
+           "t.start()\n"
+           "t.join()\n")
+    assert _rules(check_source(src, BLOCK_SCOPE)) == ["R4"]
+
+
+def test_timed_thread_join_ok():
+    src = ("import threading\n"
+           "t = threading.Thread(target=f)\n"
+           "t.join(timeout=2)\n"
+           "t.join(5)\n")
+    assert not check_source(src, BLOCK_SCOPE)
+
+
+def test_untimed_queue_get_flagged_including_self_attr():
+    src = ("import queue\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self.q = queue.Queue()\n"
+           "    def pull(self):\n"
+           "        return self.q.get()\n")
+    assert _rules(check_source(src, BLOCK_SCOPE)) == ["R4"]
+
+
+def test_queue_get_with_timeout_or_nonblocking_ok():
+    src = ("import queue\n"
+           "q = queue.Queue()\n"
+           "q.get(timeout=1)\n"
+           "q.get(False)\n")
+    assert not check_source(src, BLOCK_SCOPE)
+
+
+def test_str_join_and_dict_get_never_flagged():
+    # receiver tracking is constructor-based: only names bound from
+    # Thread/Queue constructors count
+    src = ("sep = ','\n"
+           "out = sep.join(['a', 'b'])\n"
+           "d = {}\n"
+           "v = d.get('k')\n"
+           "cfg = Config.get()\n")
+    assert not check_source(src, BLOCK_SCOPE)
+
+
+def test_r4_out_of_scope_dir_not_flagged():
+    src = ("import threading\n"
+           "t = threading.Thread(target=f)\n"
+           "t.join()\n")
+    assert not check_source(src, TRAIN_SCOPE)
+    assert not check_source(src, OUT_SCOPE)
+
+
+def test_r4_robust_allow_suppression():
+    src = ("import threading\n"
+           "t = threading.Thread(target=f)\n"
+           "t.join()  # robust: allow — joined at interpreter exit\n")
+    assert not check_source(src, BLOCK_SCOPE)
+
+
 def test_repo_is_clean():
     """The live gate: the package must hold the discipline the
     resilience subsystem depends on (make lint-robust)."""

@@ -149,13 +149,25 @@ def test_smoke_search_end_to_end():
         assert result["tpu_secs_phase2"] > 0
 
 
+@pytest.mark.slow
 def test_audit_drops_destructive_keeps_benign(tmp_path):
     """Round-2 regression gate (docs/search_postmortem_r2.md): the
     sub-policy audit must drop policies that standalone-destroy fold
     accuracy (Invert/Solarize-to-0 on a bright-glyph task) and keep
     label-preserving ones (translate/near-identity brightness).  This is
     the exact mechanism whose absence let the round-2 e2e search ship a
-    policy set that trained to random accuracy."""
+    policy set that trained to random accuracy.
+
+    Horizon note (PR-6 root-cause, docs/PARITY.md "audit-gate oracle"):
+    at 20 epochs the seeded oracle converges to 0.344 in THIS
+    container's jax build (bit-identical across PR 3..6 — the training
+    stream never changed; the original authoring environment's kernels
+    escaped the early plateau faster).  The cosine horizon is the
+    lever: 35 epochs reaches 0.979 (vs 0.267-0.354 for 2x LR at any
+    horizon).  The longer train pushes the test past the tier-1 wall
+    budget, so it is slow-marked per the ROADMAP standing constraint —
+    the audit-gate wiring stays covered in tier-1 by the cheaper
+    agreement tests that defer semantics to this one."""
     from fast_autoaugment_tpu.core.config import Config
     from fast_autoaugment_tpu.search.driver import (
         _FoldEval,
@@ -170,7 +182,7 @@ def test_audit_drops_destructive_keeps_benign(tmp_path):
         "aug": "default",
         "cutout": 0,
         "batch": 2,  # global 16 on the 8-device mesh
-        "epoch": 20,
+        "epoch": 35,
         # conf lr is scaled by mesh.size (reference lr x world_size,
         # train.py:117): 0.00625 x 8 = effective 0.05
         "lr": 0.00625,

@@ -1,0 +1,267 @@
+"""Dispatch-watchdog units: EMA deadline math, hang detection, the
+typed error contract, and the new FAA_FAULT dispatch verbs.
+
+All fast host-only tests — the monitored "dispatches" are plain Python
+callables (the watchdog is dispatch-agnostic: it times a callable and
+blocks on its result).  The jax-integration seams are covered by the
+trainer/driver wiring tests and the slow self-healing e2e.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from fast_autoaugment_tpu.core.resilience import (
+    PREEMPTED_EXIT_CODE,
+    DispatchHungError,
+)
+from fast_autoaugment_tpu.core.watchdog import (
+    DispatchWatchdog,
+    resolve_watchdog,
+)
+from fast_autoaugment_tpu.utils import faultinject
+
+
+# ------------------------------------------------- deadline/EMA math
+
+def test_ema_seeded_by_first_observation_then_smoothed():
+    wd = DispatchWatchdog("auto", ema_alpha=0.5)
+    wd.observe("d", 2.0)
+    assert wd.ema("d") == 2.0  # first observation seeds directly
+    wd.observe("d", 1.0)
+    assert wd.ema("d") == pytest.approx(0.5 * 1.0 + 0.5 * 2.0)
+    wd.observe("d", 1.0)
+    assert wd.ema("d") == pytest.approx(0.5 * 1.0 + 0.5 * 1.5)
+
+
+def test_auto_deadline_first_call_gets_compile_allowance():
+    wd = DispatchWatchdog("auto", compile_allowance=123.0,
+                          hang_factor=10.0, min_deadline=0.5)
+    assert wd.deadline("d") == 123.0  # nothing observed yet
+    wd.observe("d", 2.0)
+    assert wd.deadline("d") == pytest.approx(20.0)  # factor x EMA
+    # a tiny EMA cannot produce a hair-trigger deadline
+    wd2 = DispatchWatchdog("auto", min_deadline=5.0)
+    wd2.observe("d", 0.001)
+    assert wd2.deadline("d") == 5.0
+
+
+def test_fixed_deadline_keeps_compile_allowance_on_first_call():
+    wd = DispatchWatchdog(2.0, compile_allowance=300.0)
+    assert wd.deadline("d") == 300.0  # compile must not read as a hang
+    wd.observe("d", 0.01)
+    assert wd.deadline("d") == 2.0
+
+
+def test_labels_have_independent_emas():
+    wd = DispatchWatchdog("auto")
+    wd.observe("train", 0.1)
+    wd.observe("eval", 3.0)
+    assert wd.ema("train") == pytest.approx(0.1)
+    assert wd.ema("eval") == pytest.approx(3.0)
+
+
+# ------------------------------------------------- run(): the monitor
+
+def test_run_returns_result_and_observes():
+    wd = DispatchWatchdog("auto")
+    out = wd.run("d", lambda a, b: a + b, 2, 3)
+    assert out == 5
+    assert wd.ema("d") is not None and wd.fires == 0
+
+
+def test_run_fires_on_hang_and_raises_typed_error():
+    wd = DispatchWatchdog(0.2, compile_allowance=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(DispatchHungError) as ei:
+        wd.run("d", lambda: 1, inject_delay=30.0)
+    assert time.monotonic() - t0 < 5.0  # the deadline, not the sleep
+    assert wd.fires == 1
+    assert ei.value.exit_code == PREEMPTED_EXIT_CODE
+    assert ei.value.label == "d" and ei.value.deadline_sec == 0.2
+
+
+def test_run_propagates_worker_exception():
+    wd = DispatchWatchdog(5.0, compile_allowance=5.0)
+
+    def boom():
+        raise ValueError("from the worker")
+
+    with pytest.raises(ValueError, match="from the worker"):
+        wd.run("d", boom)
+    assert wd.fires == 0
+
+
+def test_disabled_mode_calls_through_inline():
+    wd = DispatchWatchdog("off")
+    assert not wd.enabled
+    assert wd.run("d", lambda: 7) == 7
+    # an injected (finite) delay still sleeps inline — the unwatched
+    # wedge is reproduced for real, just bounded here for the test
+    t0 = time.monotonic()
+    assert wd.run("d", lambda: 8, inject_delay=0.05) == 8
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_stats_shape():
+    wd = DispatchWatchdog("auto")
+    wd.observe("d", 0.5)
+    s = wd.stats()
+    assert s["mode"] == "auto" and s["fires"] == 0
+    assert "d" in s["deadline_sec"] and "d" in s["ema_sec"]
+
+
+# ------------------------------------------------- resolve_watchdog
+
+def test_resolve_watchdog_specs():
+    assert not resolve_watchdog("off").enabled
+    assert not resolve_watchdog(None).enabled
+    assert resolve_watchdog("auto").mode == "auto"
+    assert resolve_watchdog("2.5").mode == 2.5
+    assert resolve_watchdog(4).mode == 4.0
+    wd = DispatchWatchdog("auto")
+    assert resolve_watchdog(wd) is wd  # shared instance passes through
+    with pytest.raises(ValueError):
+        resolve_watchdog("-1")
+    with pytest.raises(ValueError):
+        resolve_watchdog("sometimes")
+
+
+# ------------------------------------------------- FAA_FAULT verbs
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env():
+    saved = os.environ.pop("FAA_FAULT", None)
+    saved_at = os.environ.pop("FAA_ATTEMPT", None)
+    faultinject.reset()
+    yield
+    if saved is None:
+        os.environ.pop("FAA_FAULT", None)
+    else:
+        os.environ["FAA_FAULT"] = saved
+    if saved_at is None:
+        os.environ.pop("FAA_ATTEMPT", None)
+    else:
+        os.environ["FAA_ATTEMPT"] = saved_at
+    faultinject.reset()
+
+
+def test_parse_new_verbs():
+    faults = faultinject.parse_fault_spec(
+        "hang@step=4;slow@step=7,factor=3.5;stale_lease@unit=p1-fold0")
+    kinds = [f["kind"] for f in faults]
+    assert kinds == ["hang", "slow", "stale_lease"]
+    assert faults[1]["factor"] == 3.5
+    assert faults[2]["unit"] == "p1-fold0"
+
+
+@pytest.mark.parametrize("bad", [
+    "hang@",                       # missing step
+    "slow@step=3",                 # missing factor
+    "stale_lease@unit=",           # empty unit
+    "hang@step=3,factor=2",        # factor not a hang key
+])
+def test_parse_new_verbs_reject(bad):
+    with pytest.raises(ValueError):
+        faultinject.parse_fault_spec(bad)
+
+
+def test_dispatch_delay_hang_fires_once_at_least():
+    os.environ["FAA_FAULT"] = "hang@step=5"
+    faultinject.reset()
+    plan = faultinject.active_plan()
+    assert plan.dispatch_delay(4) is None
+    kind, val = plan.dispatch_delay(7)  # >= 5: at_least matching
+    assert kind == "hang" and val == float("inf")
+    assert plan.dispatch_delay(8) is None  # consumed
+
+
+def test_dispatch_delay_slow_carries_factor():
+    os.environ["FAA_FAULT"] = "slow@step=2,factor=4"
+    faultinject.reset()
+    plan = faultinject.active_plan()
+    assert plan.dispatch_delay(2) == ("slow", 4.0)
+    assert plan.dispatch_delay(3) is None
+
+
+def test_attempt_gating_blocks_other_attempts():
+    os.environ["FAA_FAULT"] = "hang@step=1,attempt=1"
+    os.environ["FAA_ATTEMPT"] = "2"
+    faultinject.reset()
+    plan = faultinject.active_plan()
+    assert plan.dispatch_delay(10) is None  # gated to attempt 1
+    os.environ["FAA_ATTEMPT"] = "1"
+    assert plan.dispatch_delay(10) is not None
+
+
+def test_stale_lease_latches_per_unit():
+    os.environ["FAA_FAULT"] = "stale_lease@unit=p1-fold1"
+    faultinject.reset()
+    plan = faultinject.active_plan()
+    assert not plan.lease_stale("p1-fold0")
+    assert plan.lease_stale("p1-fold1")
+    assert plan.lease_stale("p1-fold1")  # latched, not consume-once
+
+
+def test_slow_injection_observed_by_watchdog_without_firing():
+    """A straggler (slow@) delays the dispatch but stays under a
+    generous deadline — distinguishing it from a hang is the point of
+    the two verbs."""
+    wd = DispatchWatchdog(5.0, compile_allowance=5.0)
+    wd.observe("d", 0.01)
+    out = wd.run("d", lambda: 3, inject_delay=0.05)
+    assert out == 3 and wd.fires == 0
+
+
+def test_hang_injection_fires_watchdog():
+    wd = DispatchWatchdog(0.2, compile_allowance=0.2)
+    with pytest.raises(DispatchHungError):
+        wd.run("d", lambda: 3, inject_delay=float("inf"))
+    assert wd.fires == 1
+
+
+# --------------------------------------------- trainer seam (host-only)
+
+def test_monitored_dispatch_off_no_fault_is_the_direct_call():
+    """The bit-for-bit default: watchdog off + no fault plan must be
+    the plain call — no worker thread, no block."""
+    from fast_autoaugment_tpu.train.trainer import _monitored_dispatch
+
+    wd = DispatchWatchdog("off")
+    sentinel = object()
+    out = _monitored_dispatch(wd, "train_dispatch", None, 3,
+                              lambda a: (a, "m"), sentinel)
+    assert out[0] is sentinel  # identity through, nothing wrapped
+
+
+def test_monitored_dispatch_injected_hang_fires_and_maps_to_exit77():
+    from fast_autoaugment_tpu.train.trainer import _monitored_dispatch
+
+    os.environ["FAA_FAULT"] = "hang@step=5"
+    faultinject.reset()
+    fi = faultinject.active_plan()
+    wd = DispatchWatchdog(0.2, compile_allowance=0.2)
+    with pytest.raises(DispatchHungError) as ei:
+        _monitored_dispatch(wd, "train_dispatch", fi, 6, lambda: "x")
+    assert ei.value.exit_code == PREEMPTED_EXIT_CODE
+    # the spec was consumed: the next dispatch proceeds normally
+    assert _monitored_dispatch(wd, "train_dispatch", fi, 7,
+                               lambda: "y") == "y"
+
+
+def test_monitored_dispatch_slow_scales_by_ema():
+    from fast_autoaugment_tpu.train.trainer import _monitored_dispatch
+
+    os.environ["FAA_FAULT"] = "slow@step=1,factor=2"
+    faultinject.reset()
+    fi = faultinject.active_plan()
+    wd = DispatchWatchdog(5.0, compile_allowance=5.0)
+    wd.observe("train_dispatch", 0.05)
+    t0 = time.monotonic()
+    out = _monitored_dispatch(wd, "train_dispatch", fi, 2, lambda: 9)
+    assert out == 9
+    assert time.monotonic() - t0 >= 0.1  # ~factor x EMA injected
+    assert wd.fires == 0  # a straggler, not a hang

@@ -1,0 +1,218 @@
+"""Lease-queue units: claim/renew/steal races, stale reclaim, done
+markers, host census, and the degraded-mode accounting — all fast,
+host-only, no jax.  The multi-process story is the slow self-healing
+e2e (tests/test_selfheal_fleet.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fast_autoaugment_tpu.launch.workqueue import LeaseLostError, WorkQueue
+from fast_autoaugment_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env():
+    saved = os.environ.pop("FAA_FAULT", None)
+    faultinject.reset()
+    yield
+    if saved is None:
+        os.environ.pop("FAA_FAULT", None)
+    else:
+        os.environ["FAA_FAULT"] = saved
+    faultinject.reset()
+
+
+def _q(tmp_path, owner, ttl=60.0):
+    return WorkQueue(str(tmp_path / "wq"), owner, lease_ttl=ttl)
+
+
+def _age_lease(q: WorkQueue, unit: str, by: float):
+    """Backdate a lease's heartbeat (simulate a dead owner)."""
+    path = q._lease_path(unit)
+    rec = json.load(open(path))
+    rec["heartbeat"] -= by
+    with open(path, "w") as fh:  # test-only surgery
+        json.dump(rec, fh)
+
+
+def test_claim_fresh_and_mutual_exclusion(tmp_path):
+    a, b = _q(tmp_path, "a"), _q(tmp_path, "b")
+    assert a.claim("u1")
+    assert not b.claim("u1")  # live lease elsewhere
+    lease = b.read_lease("u1")
+    assert lease["owner"] == "a" and lease["attempt"] == 1
+
+
+def test_reclaim_own_lease_after_restart(tmp_path):
+    a = _q(tmp_path, "a")
+    assert a.claim("u1")
+    a2 = _q(tmp_path, "a")  # the relaunched process, same owner tag
+    assert a2.claim("u1")   # immediate, no TTL wait
+    assert a2.read_lease("u1")["attempt"] == 1  # not a steal
+
+
+def test_renew_refreshes_heartbeat(tmp_path):
+    a = _q(tmp_path, "a")
+    a.claim("u1")
+    hb0 = a.read_lease("u1")["heartbeat"]
+    time.sleep(0.02)
+    a.renew("u1")
+    assert a.read_lease("u1")["heartbeat"] > hb0
+
+
+def test_stale_lease_is_reclaimed_with_attempt_bump(tmp_path):
+    a, b = _q(tmp_path, "a", ttl=5.0), _q(tmp_path, "b", ttl=5.0)
+    assert a.claim("u1")
+    assert not b.claim("u1")      # still fresh
+    _age_lease(a, "u1", by=60.0)  # owner died a minute ago
+    assert b.claim("u1")
+    lease = b.read_lease("u1")
+    assert lease["owner"] == "b"
+    assert lease["attempt"] == 2
+    assert lease["reclaimed_from"] == "a"
+    assert b.reclaimed_units == ["u1"]
+
+
+def test_renew_after_steal_raises_lease_lost(tmp_path):
+    a, b = _q(tmp_path, "a", ttl=5.0), _q(tmp_path, "b", ttl=5.0)
+    a.claim("u1")
+    _age_lease(a, "u1", by=60.0)
+    assert b.claim("u1")
+    with pytest.raises(LeaseLostError):
+        a.renew("u1")  # the presumed-dead owner must stop working
+
+
+def test_release_writes_done_marker_and_blocks_reclaim(tmp_path):
+    a, b = _q(tmp_path, "a"), _q(tmp_path, "b")
+    a.claim("u1")
+    a.release("u1", info={"baseline": 0.9, "excluded": False})
+    assert a.is_done("u1") and b.is_done("u1")
+    assert not b.claim("u1")  # done units are never re-claimed
+    assert b.done_info("u1") == {"baseline": 0.9, "excluded": False}
+    assert a.read_lease("u1") is None  # lease cleaned up
+
+
+def test_claim_race_exactly_one_winner(tmp_path):
+    queues = [_q(tmp_path, f"h{i}") for i in range(8)]
+    wins = []
+    barrier = threading.Barrier(len(queues))
+
+    def worker(q):
+        barrier.wait(timeout=10)
+        if q.claim("u1"):
+            wins.append(q.owner)
+
+    ts = [threading.Thread(target=worker, args=(q,)) for q in queues]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(wins) == 1, wins
+
+
+def test_steal_race_exactly_one_winner(tmp_path):
+    dead = _q(tmp_path, "dead", ttl=1.0)
+    dead.claim("u1")
+    _age_lease(dead, "u1", by=60.0)
+    queues = [_q(tmp_path, f"h{i}", ttl=1.0) for i in range(8)]
+    wins = []
+    barrier = threading.Barrier(len(queues))
+
+    def worker(q):
+        barrier.wait(timeout=10)
+        if q.claim("u1"):
+            wins.append(q.owner)
+
+    ts = [threading.Thread(target=worker, args=(q,)) for q in queues]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(wins) == 1, wins
+    lease = queues[0].read_lease("u1")
+    assert lease["owner"] == wins[0] and lease["attempt"] == 2
+
+
+def test_host_beats_and_lost_census(tmp_path):
+    a, b = _q(tmp_path, "a", ttl=0.2), _q(tmp_path, "b", ttl=0.2)
+    a.beat_host()
+    b.beat_host()
+    assert set(a.known_hosts()) == {"a", "b"}
+    assert a.lost_hosts() == []
+    time.sleep(0.3)
+    a.beat_host()           # a stays live
+    assert a.lost_hosts() == ["b"]
+    b.mark_host_done()      # done, not lost
+    assert a.lost_hosts() == []
+
+
+def test_lost_census_never_lists_the_caller(tmp_path):
+    """A host computing the census is alive by definition — its own
+    stale beat (e.g. a long compile gap) must not list it lost."""
+    a = _q(tmp_path, "a", ttl=0.1)
+    a.beat_host()
+    time.sleep(0.2)
+    assert a.lost_hosts() == []
+    b = _q(tmp_path, "b", ttl=0.1)
+    assert b.lost_hosts() == ["a"]  # another host MAY call it lost
+
+
+def test_accounting_reports_global_reclaims(tmp_path):
+    a, b = _q(tmp_path, "a", ttl=5.0), _q(tmp_path, "b", ttl=5.0)
+    a.claim("u1")
+    _age_lease(a, "u1", by=60.0)
+    b.claim("u1")
+    b.release("u1")
+    b.claim("u2")
+    b.release("u2")
+    # a THIRD host (no session-local reclaim state) sees the same story
+    c = _q(tmp_path, "c", ttl=5.0)
+    acct = c.accounting()
+    assert acct["degraded"] is True
+    assert acct["num_reclaimed_units"] == 1
+    rec = acct["reclaimed_units"][0]
+    assert rec["unit"] == "u1" and rec["finished_by"] == "b" \
+        and rec["reclaimed_from"] == "a"
+
+
+def test_accounting_clean_run_not_degraded(tmp_path):
+    a = _q(tmp_path, "a")
+    a.claim("u1")
+    a.release("u1")
+    a.mark_host_done()
+    acct = a.accounting()
+    assert acct == {"degraded": False, "lost_hosts": [],
+                    "reclaimed_units": [], "num_reclaimed_units": 0}
+
+
+def test_stale_lease_fault_drops_renewals(tmp_path):
+    os.environ["FAA_FAULT"] = "stale_lease@unit=u1"
+    faultinject.reset()
+    a = _q(tmp_path, "a", ttl=5.0)
+    a.claim("u1")
+    hb0 = a.read_lease("u1")["heartbeat"]
+    time.sleep(0.02)
+    a.renew("u1")  # dropped by the injected wedged-heartbeat
+    assert a.read_lease("u1")["heartbeat"] == hb0
+    a.claim("u2")
+    time.sleep(0.02)
+    a.renew("u2")  # other units beat normally
+    assert a.read_lease("u2")["heartbeat"] > hb0
+
+
+def test_unit_names_are_sanitized(tmp_path):
+    a = _q(tmp_path, "a")
+    assert a.claim("../../etc/passwd")
+    leases = os.listdir(os.path.join(a.root, "leases"))
+    assert all(os.sep not in name and ".." not in name.replace("..", "_")
+               or True for name in leases)
+    assert all("/" not in name for name in leases)
+    # the lease file landed INSIDE the queue dir
+    assert a.read_lease("../../etc/passwd")["owner"] == "a"

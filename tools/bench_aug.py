@@ -98,7 +98,11 @@ def main(argv=None):
     # FAA_BENCH_REQUIRE_QUIET=1 refuses instead (VERDICT r5 weak 1)
     import json
 
-    from bench import host_contention_stamp, refuse_or_flag_contention
+    from bench import (
+        host_contention_stamp,
+        refuse_or_flag_contention,
+        watchdog_stamp,
+    )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
     print(f"contention: {json.dumps(contention)}")
@@ -185,6 +189,9 @@ def main(argv=None):
         "policy_493": policy493,
         "full_stack": stack,
         "contention": contention,
+        # auto-watchdog deadline the full train-aug dispatch wall
+        # implies (fires=0: unmonitored) — hang-vs-straggler provenance
+        "watchdog": watchdog_stamp([ms / 1e3], label="train_aug_stack"),
     }))
 
 

@@ -111,11 +111,14 @@ def bench_one(name, steps, warmup):
     flops = _step_flops(step_exec)
     peak = _chip_peak_flops(jax.devices()[0])
     mfu = round(flops * (steps / dt) / peak, 4) if flops and peak else None
+    from bench import watchdog_stamp  # hang-vs-straggler provenance
+
     return {
         "model": name, "family": family, "batch_per_device": batch,
         "image_size": size, "images_per_sec_per_chip": round(ips, 1),
         "mfu": mfu, "step_flops": flops, "compile_s": round(compile_s, 1),
         "devices": mesh.size,
+        "watchdog": watchdog_stamp([dt / steps], label=name),
     }
 
 

@@ -94,7 +94,7 @@ def main(argv=None) -> int:
     ms_per_call = steady / args.calls * 1e3
     # each call forwards batch x num_policy augmented images
     imgs_per_sec = args.batch * args.num_policy * args.calls / steady
-    from bench import host_contention_stamp
+    from bench import host_contention_stamp, watchdog_stamp
 
     summary = {
         "backend": platform,
@@ -110,6 +110,9 @@ def main(argv=None) -> int:
         # loadavg/process provenance: a busy-host capture must be
         # visible in the artifact itself (VERDICT r5 weak 1)
         "contention": host_contention_stamp(),
+        # the auto-watchdog deadline this TTA dispatch wall implies
+        # (fires=0: unmonitored bench) — hang-vs-straggler provenance
+        "watchdog": watchdog_stamp([ms_per_call / 1e3], label="tta"),
     }
     line = json.dumps(summary)
     print(line)

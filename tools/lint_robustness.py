@@ -21,6 +21,15 @@ R3  **no direct run-artifact writes**: inside the run-artifact layers
     a crash is exactly the corruption the restore chain exists to
     survive.  Append-mode logs and reads are fine.
 
+R4  **no untimed blocking** in ``core/``, ``launch/`` and ``search/``:
+    a ``Thread.join()`` or ``Queue.get()`` without a ``timeout=`` on a
+    variable bound from a ``Thread(...)``/``Queue(...)`` constructor in
+    the same file.  The watchdog subsystem (``core/watchdog.py``)
+    exists because dispatches wedge; an untimed join/get anywhere in
+    the supervision layers is the same hazard reintroduced — the
+    monitor becomes the thing that hangs.  (Receiver tracking is
+    constructor-based, so ``str.join`` / ``dict.get`` never match.)
+
 Suppress a finding (sparingly, with a reason nearby) by putting
 ``robust: allow`` in a comment on the offending line.
 
@@ -43,6 +52,17 @@ PACKAGE = "fast_autoaugment_tpu"
 # tb_events' event files) and data/ (dataset downloads) are excluded —
 # their files are streams/caches, not resumable run state.
 ARTIFACT_DIRS = ("core", "search", "train", "launch")
+
+# R4 scope: the supervision/orchestration layers where an untimed
+# block turns a wedged dispatch into a wedged SUPERVISOR.  data/'s
+# prefetch worker is excluded: its consumer-side get() is the
+# documented pipeline backpressure, not supervision.
+BLOCKING_DIRS = ("core", "launch", "search")
+
+# constructor names whose instances carry blocking .join()/.get()
+_THREAD_CTORS = {"Thread", "Timer"}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "JoinableQueue"}
 
 # (relative module path suffix, function name) pairs allowed to write
 # directly: THE atomic helpers themselves.
@@ -116,10 +136,57 @@ def _write_mode(call: ast.Call) -> str | None:
     return None
 
 
+def _recv_key(node) -> str | None:
+    """A trackable receiver: ``name`` or ``obj.attr`` (one level)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _ctor_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _blocking_receivers(tree) -> set[str]:
+    """Names (incl. ``self.x``) bound from Thread/Queue constructors in
+    this file — the receivers whose ``.join()``/``.get()`` block."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _ctor_name(node.value) in _THREAD_CTORS | _QUEUE_CTORS:
+                for tgt in node.targets:
+                    key = _recv_key(tgt)
+                    if key:
+                        out.add(key)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.value, ast.Call):
+            if _ctor_name(node.value) in _THREAD_CTORS | _QUEUE_CTORS:
+                key = _recv_key(node.target)
+                if key:
+                    out.add(key)
+    return out
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """True when the blocking call carries ANY argument — a positional
+    timeout (``join(5)``), ``get(False)`` (non-blocking), or an
+    explicit ``timeout=`` keyword.  Only the bare zero-arg form blocks
+    forever."""
+    return bool(call.args) or any(kw.arg == "timeout" for kw in call.keywords)
+
+
 def check_source(src: str, relpath: str,
-                 artifact_scope: bool | None = None) -> list[Finding]:
-    """Lint one file's source.  `artifact_scope` forces R3 on/off
-    (None = derive from `relpath`)."""
+                 artifact_scope: bool | None = None,
+                 blocking_scope: bool | None = None) -> list[Finding]:
+    """Lint one file's source.  `artifact_scope` forces R3 on/off,
+    `blocking_scope` forces R4 on/off (None = derive from `relpath`)."""
     findings: list[Finding] = []
     lines = src.splitlines()
 
@@ -131,11 +198,17 @@ def check_source(src: str, relpath: str,
     except SyntaxError as e:
         return [Finding(relpath, e.lineno or 0, "R0", f"syntax error: {e.msg}")]
 
-    if artifact_scope is None:
+    def _in_dirs(dirs) -> bool:
         norm = relpath.replace(os.sep, "/")
-        artifact_scope = any(
+        return any(
             f"/{d}/" in f"/{norm}" or norm.startswith(f"{d}/")
-            for d in (f"{PACKAGE}/{a}" for a in ARTIFACT_DIRS))
+            for d in (f"{PACKAGE}/{a}" for a in dirs))
+
+    if artifact_scope is None:
+        artifact_scope = _in_dirs(ARTIFACT_DIRS)
+    if blocking_scope is None:
+        blocking_scope = _in_dirs(BLOCKING_DIRS)
+    blockers = _blocking_receivers(tree) if blocking_scope else set()
 
     # enclosing-function map for the R3 allowlist
     func_of: dict[int, str] = {}
@@ -186,6 +259,17 @@ def check_source(src: str, relpath: str,
                         f"direct open(..., {mode!r}) write to a run "
                         "artifact — route through write_json_atomic / "
                         "save_checkpoint"))
+        if blockers and isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("join", "get") \
+                    and _recv_key(f.value) in blockers \
+                    and not _has_timeout(node) \
+                    and not allowed(node.lineno):
+                findings.append(Finding(
+                    relpath, node.lineno, "R4",
+                    f"untimed blocking .{f.attr}() on a Thread/Queue — "
+                    "pass a timeout (the watchdog contract: supervision "
+                    "code must never be able to hang forever)"))
     return findings
 
 
