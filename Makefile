@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch clean reproduce
+.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-compile clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
@@ -44,6 +44,19 @@ bench-aug:
 # Honors FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host).
 bench-dispatch:
 	python bench.py --dispatch-only
+
+# AOT policy-serving bench: p50/p99 latency + imgs/s at fixed offered
+# QPS through the batch-coalescing PolicyServer, with contention,
+# watchdog and compile_cache stamps; re-verifies served outputs match
+# direct apply_policy bitwise (docs/BENCHMARKS.md "Compile cost & cache")
+bench-serve:
+	python tools/bench_serve.py
+
+# cold/warm compile-tax bench: the same train-step workload in two
+# fresh processes sharing one FAA_COMPILE_CACHE dir — the warm process
+# must report cache hits and a first step in seconds, not minutes
+bench-compile:
+	python tools/bench_compile.py
 
 clean:
 	$(MAKE) -C native clean

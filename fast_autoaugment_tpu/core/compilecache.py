@@ -1,0 +1,332 @@
+"""Persistent-compile-cache wiring + the instrumented compile seam.
+
+Every process in this stack used to re-pay 23-55 s of XLA compile
+(BENCH_r02-r05) before its first real step, and the PR 5/6 resilience
+machinery multiplies that tax: every exit-77 resume, fleet retry and
+reclaimed work unit is a FRESH process that recompiled everything from
+scratch.  This module kills the recurrence with two pieces:
+
+1. **Persistent compilation cache** (``--compile-cache {off,DIR}``,
+   env ``FAA_COMPILE_CACHE``): JAX's on-disk executable cache
+   (``jax_compilation_cache_dir``) is pointed at a shared directory so
+   a relaunched process DESERIALIZES the executables its predecessor
+   compiled instead of re-lowering them — the pjit compilation-cache
+   discipline of the TPUv4 pjit trainers (PAPERS.md: *Scalable Training
+   of Language Models using JAX pjit and TPUv4*).  ``off`` (the
+   default) is bit-for-bit the historical behavior: nothing is read or
+   written, and the cache never changes numerics either way — only
+   where executables come from.
+
+2. **The compile seam** (:func:`seam_jit` / :func:`aot_compile`): every
+   jit entry point in ``train/``, ``search/`` and ``serve/`` routes
+   through one wrapper (the ``compile_step_with_plan`` pattern,
+   SNIPPETS [3]) that times each first-call lowering, classifies it
+   hit/miss against the persistent cache's monitoring events, and
+   aggregates the evidence so ``search_result.json``, the bench JSON
+   lines and the resilience resume path can PROVE a warm process
+   reached its first step in seconds (``compile_cache{dir, hits,
+   misses, first_step_secs}``).  Rule R5 in ``tools/lint_robustness.py``
+   keeps future hot paths on the seam.
+
+The hit/miss counters come from JAX's own monitoring events
+(``/jax/compilation_cache/cache_{hits,misses}``), so they count every
+XLA module the process compiles — including the small auxiliary ones
+(``convert_element_type`` etc.) outside any seam label.  Per-label
+classification snapshots the counters around the label's first call;
+the repo's dispatch discipline is single-threaded per step factory, so
+the deltas attribute cleanly in practice (a concurrent compile would
+merely make a verdict pessimistic, never silently wrong the other way).
+
+The watchdog coupling (``core/watchdog.py``): once this process has
+OBSERVED cache hits and no misses (:func:`process_is_warm`), the
+watchdog shrinks its generous first-call compile allowance — a warm
+process must not be able to hide a genuine multi-minute hang behind a
+compile grace window it no longer needs.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = [
+    "ENV_VAR",
+    "resolve_compile_cache",
+    "configure_compile_cache",
+    "enable_compile_cache",
+    "seam_jit",
+    "instrument_jitted",
+    "aot_compile",
+    "compile_cache_stats",
+    "cache_dir",
+    "process_is_warm",
+]
+
+logger = get_logger("faa_tpu.compilecache")
+
+#: env handoff: the CLIs export the resolved dir here so every child
+#: process (fleet-launched hosts, exit-77 relaunches, subprocess e2e
+#: reruns) inherits the shared cache without re-plumbing flags
+ENV_VAR = "FAA_COMPILE_CACHE"
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+_dir: str | None = None
+_hits = 0
+_misses = 0
+# per-seam-label first-call evidence:
+# {label: {"sec": float, "hit": n, "miss": n, "uncached": n, "none": n}}
+_labels: dict[str, dict] = {}
+_listener_registered = False
+
+
+def _listener(event: str, **_kwargs: Any) -> None:
+    global _hits, _misses
+    if event == _HIT_EVENT:
+        with _lock:
+            _hits += 1
+    elif event == _MISS_EVENT:
+        with _lock:
+            _misses += 1
+
+
+def resolve_compile_cache(spec: str | None = None) -> str | None:
+    """``--compile-cache {off,DIR}`` (or None) -> cache dir or None.
+
+    An unset/``off`` spec falls back to the :data:`ENV_VAR` environment
+    handoff — that is how fleet-launched hosts and exit-77 relaunches
+    inherit the shared dir without carrying the flag.  ``off`` in the
+    environment disables too.
+    """
+    spec = ("" if spec is None else str(spec)).strip()
+    if spec.lower() in ("", "off"):
+        env = os.environ.get(ENV_VAR, "").strip()
+        if env.lower() in ("", "off"):
+            return None
+        return env
+    return spec
+
+
+def enable_compile_cache(directory: str) -> str:
+    """Point JAX's persistent compilation cache at `directory`.
+
+    Creates the dir, drops the min-compile-time/min-entry-size floors
+    (JAX's 1 s default would silently skip exactly the small dev/test
+    compiles the warm-start tests pin), registers the hit/miss event
+    listener, and exports :data:`ENV_VAR` for child processes.
+    Idempotent; re-enabling with a different dir re-points the cache
+    (logged — the stats keep accumulating process-wide).
+    """
+    global _dir, _listener_registered
+    import jax
+    from jax.experimental.compilation_cache import compilation_cache as jax_cc
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    jax_cc.set_cache_dir(directory)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("FAA_COMPILE_CACHE_MIN_COMPILE_SECS", "0")))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if _dir is None or _dir != directory:
+        # the cache-used verdict is a one-shot per-process latch inside
+        # jax: a process that compiled ANYTHING before the dir was set
+        # has latched "disabled" — reset so enabling mid-process works
+        # (the trainers/driver configure after import-time jits)
+        jax_cc.reset_cache()
+    with _lock:
+        if not _listener_registered:
+            jax.monitoring.register_event_listener(_listener)
+            _listener_registered = True
+        if _dir is not None and _dir != directory:
+            logger.warning("compile cache re-pointed %s -> %s", _dir, directory)
+        _dir = directory
+    os.environ[ENV_VAR] = directory
+    logger.info("persistent compile cache enabled at %s", directory)
+    return directory
+
+
+def configure_compile_cache(spec: str | None = None) -> str | None:
+    """Resolve `spec` (flag value, ``None`` = env only) and enable the
+    cache when it names a directory.  Returns the active dir or None."""
+    directory = resolve_compile_cache(spec)
+    if directory:
+        return enable_compile_cache(directory)
+    return None
+
+
+def cache_dir() -> str | None:
+    """The active persistent-cache directory, or None when disabled."""
+    return _dir
+
+
+def process_is_warm() -> bool:
+    """True once this process has PROVEN the cache warm: enabled, at
+    least one observed hit, and not a single miss.  The watchdog uses
+    this to shrink its first-call compile allowance
+    (``core/watchdog.py``) — a miss anywhere means cold compiles may
+    still be coming and the generous window stays."""
+    with _lock:
+        return _dir is not None and _hits > 0 and _misses == 0
+
+
+def _snapshot() -> tuple[int, int]:
+    with _lock:
+        return _hits, _misses
+
+
+def _classify(h0: int, m0: int) -> str:
+    """Verdict for a compile window bounded by the (h0, m0) snapshot:
+    ``uncached`` (cache off), ``miss`` (any module compiled fresh),
+    ``hit`` (every module deserialized), ``none`` (no cache event — the
+    in-process tracing cache already held the executable)."""
+    if _dir is None:
+        return "uncached"
+    with _lock:
+        dh, dm = _hits - h0, _misses - m0
+    if dm > 0:
+        return "miss"
+    if dh > 0:
+        return "hit"
+    return "none"
+
+
+def _record(label: str, sec: float, verdict: str) -> None:
+    with _lock:
+        rec = _labels.setdefault(
+            label, {"sec": 0.0, "hit": 0, "miss": 0, "uncached": 0, "none": 0})
+        rec["sec"] += float(sec)
+        rec[verdict] += 1
+    if sec >= 1.0:
+        logger.info("compile seam %r: first call %.1fs (%s)",
+                    label, sec, verdict)
+
+
+class _SeamWrapped:
+    """A jitted callable instrumented at its first invocation.
+
+    Transparent otherwise: ``lower``/``_cache_size``/every other
+    attribute delegates to the wrapped jit object (``bench.py`` AOT-
+    lowers through ``.lower``; ``search/census.py`` probes
+    ``_cache_size``), and post-first-call invocations are a single
+    attribute load + call on top of the C++ fast dispatch path.
+    """
+
+    def __init__(self, jitted: Callable, label: str):
+        self._jitted = jitted
+        self._seam_label = label
+        self._first_done = False
+        functools.update_wrapper(self, jitted, updated=())
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        if self._first_done:
+            return self._jitted(*args, **kwargs)
+        h0, m0 = _snapshot()
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        sec = time.perf_counter() - t0
+        self._first_done = True
+        _record(self._seam_label, sec, _classify(h0, m0))
+        return out
+
+    def __getattr__(self, name: str):
+        return getattr(self._jitted, name)
+
+
+def instrument_jitted(jitted: Callable, *, label: str) -> Callable:
+    """Wrap an ALREADY-jitted callable in the compile seam."""
+    return _SeamWrapped(jitted, label)
+
+
+def seam_jit(fn: Callable, *, label: str, **jit_kwargs: Any) -> Callable:
+    """``jax.jit`` through the compile seam — THE way train/search/serve
+    build jitted entry points (lint rule R5 flags direct ``jax.jit``
+    there).  `label` names the entry point in the stats; reuse the
+    watchdog's dispatch labels where one exists so the two evidence
+    streams line up."""
+    import jax
+
+    return _SeamWrapped(jax.jit(fn, **jit_kwargs), label)
+
+
+def aot_compile(fn: Callable, *, label: str, example_args: tuple,
+                jit_kwargs: dict | None = None) -> tuple[Any, dict]:
+    """``jax.jit(fn).lower(*example_args).compile()`` through the seam.
+
+    The ahead-of-time half of the seam (the serving path's executables,
+    the Anakin dispatch-only execution style — PAPERS.md *Podracer
+    architectures*): compile cost lands HERE, at load time, and the
+    serving loop only ever dispatches.  `example_args` are arrays or
+    ``jax.ShapeDtypeStruct`` specs.  Returns ``(compiled_executable,
+    {"sec", "verdict"})``; with the persistent cache enabled and warm,
+    the verdict is ``hit`` and `sec` is deserialization, not lowering.
+    """
+    import jax
+
+    h0, m0 = _snapshot()
+    t0 = time.perf_counter()
+    compiled = jax.jit(fn, **(jit_kwargs or {})).lower(*example_args).compile()
+    sec = time.perf_counter() - t0
+    verdict = _classify(h0, m0)
+    _record(label, sec, verdict)
+    return compiled, {"sec": round(sec, 3), "verdict": verdict}
+
+
+def compile_cache_stats() -> dict:
+    """The artifact stamp: ``compile_cache{dir, enabled, hits, misses,
+    first_step_secs, labels}``.
+
+    ``hits``/``misses`` are the process-wide persistent-cache event
+    counts; ``first_step_secs`` is the total first-call seconds paid
+    through the seam — the compile tax this process actually spent
+    before its steps/evals/serves ran.  Stamped into
+    ``search_result.json``, every bench JSON line, the trainer result,
+    and logged on the resilience resume path.
+    """
+    with _lock:
+        labels = {
+            lb: {"sec": round(r["sec"], 3), "hit": r["hit"],
+                 "miss": r["miss"], "uncached": r["uncached"],
+                 "none": r["none"]}
+            for lb, r in sorted(_labels.items())
+        }
+        return {
+            "dir": _dir,
+            "enabled": _dir is not None,
+            "hits": _hits,
+            "misses": _misses,
+            "first_step_secs": round(sum(r["sec"] for r in _labels.values()), 3),
+            "labels": labels,
+        }
+
+
+def _reset_stats_for_tests() -> None:
+    """Zero the counters/labels (NOT the cache config) — test isolation
+    only; the listener stays registered."""
+    global _hits, _misses
+    with _lock:
+        _hits = 0
+        _misses = 0
+        _labels.clear()
+
+
+def _disable_for_tests() -> None:
+    """Detach the cache dir (config side too) — test isolation only."""
+    global _dir
+    import jax
+    from jax.experimental.compilation_cache import compilation_cache as jax_cc
+
+    enabled = _dir is not None
+    with _lock:
+        _dir = None
+    jax.config.update("jax_compilation_cache_dir", None)
+    if enabled:
+        jax_cc.reset_cache()  # clear the process latch too
+    os.environ.pop(ENV_VAR, None)

@@ -55,6 +55,12 @@ logger = get_logger("faa_tpu.watchdog")
 #: first-call-per-label deadline: covers XLA compile (observed 23-55 s
 #: per process on this repo's models, BENCH_r02-r05) with slack
 DEFAULT_COMPILE_ALLOWANCE_SEC = 600.0
+#: first-call deadline once the compile tax is KNOWN paid (persistent
+#: compile cache hit / AOT-loaded executable): covers executable
+#: deserialization plus a long first dispatch, nothing like a compile —
+#: a warm process must not hide a 10-minute hang behind the blind
+#: compile window above (core/compilecache.py)
+DEFAULT_WARM_ALLOWANCE_SEC = 60.0
 #: auto mode: deadline = max(min_deadline, hang_factor * EMA)
 DEFAULT_HANG_FACTOR = 20.0
 DEFAULT_MIN_DEADLINE_SEC = 10.0
@@ -78,6 +84,7 @@ class DispatchWatchdog:
 
     def __init__(self, mode: str | float = "off", *,
                  compile_allowance: float = DEFAULT_COMPILE_ALLOWANCE_SEC,
+                 warm_allowance: float = DEFAULT_WARM_ALLOWANCE_SEC,
                  hang_factor: float = DEFAULT_HANG_FACTOR,
                  min_deadline: float = DEFAULT_MIN_DEADLINE_SEC,
                  ema_alpha: float = DEFAULT_EMA_ALPHA):
@@ -91,12 +98,17 @@ class DispatchWatchdog:
             mode = float(mode)
         self.mode = mode
         self.compile_allowance = float(compile_allowance)
+        self.warm_allowance = float(warm_allowance)
         self.hang_factor = float(hang_factor)
         self.min_deadline = float(min_deadline)
         self.ema_alpha = float(ema_alpha)
         self.fires = 0
         self._ema: dict[str, float] = {}
         self._calls: dict[str, int] = {}
+        # labels whose executable is KNOWN pre-compiled (AOT-loaded) —
+        # their first call gets the warm allowance, never the blind
+        # compile window
+        self._warm_labels: set[str] = set()
 
     @property
     def enabled(self) -> bool:
@@ -107,13 +119,46 @@ class DispatchWatchdog:
         the first completed call)."""
         return self._ema.get(label)
 
+    def mark_compile_warm(self, label: str) -> None:
+        """Declare `label`'s executable pre-compiled (AOT-loaded / known
+        persistent-cache hit): its first call gets the bounded
+        ``warm_allowance`` instead of the blind compile window."""
+        self._warm_labels.add(label)
+
+    def _first_call_warm(self, label: str) -> bool:
+        """Whether `label`'s FIRST call should be treated as compile-free:
+        explicitly marked warm, or the process has already proven the
+        persistent compile cache warm (hits observed, zero misses —
+        ``core/compilecache.py``)."""
+        if label in self._warm_labels:
+            return True
+        try:
+            from fast_autoaugment_tpu.core import compilecache
+        except ImportError:  # pragma: no cover — core package is intact
+            return False
+        return compilecache.process_is_warm()
+
     def deadline(self, label: str) -> float:
-        """The deadline the NEXT :meth:`run` for `label` will use."""
+        """The deadline the NEXT :meth:`run` for `label` will use.
+
+        The first call per label normally gets the generous compile
+        allowance (a 23-55 s first compile must never read as a hang);
+        when the compile seam has reported cache hits and no misses —
+        or the label's executable was AOT-loaded
+        (:meth:`mark_compile_warm`) — that allowance shrinks to the
+        normal deadline floor (``warm_allowance``), so a warm process
+        cannot hide a genuine multi-minute hang behind a compile grace
+        window it no longer needs."""
         first = self._calls.get(label, 0) == 0
+        warm = first and self._first_call_warm(label)
         if isinstance(self.mode, float):
-            return max(self.mode, self.compile_allowance) if first else self.mode
+            if first and not warm:
+                return max(self.mode, self.compile_allowance)
+            return self.mode
         # auto: generous compile allowance first, then EMA-derived
         if first or label not in self._ema:
+            if warm:
+                return max(self.min_deadline, self.warm_allowance)
             return self.compile_allowance
         return max(self.min_deadline, self.hang_factor * self._ema[label])
 
@@ -189,6 +234,7 @@ class DispatchWatchdog:
             "fires": self.fires,
             "deadline_sec": {lb: self.deadline(lb) for lb in self._calls},
             "ema_sec": {lb: round(v, 6) for lb, v in self._ema.items()},
+            "warm_labels": sorted(self._warm_labels),
         }
 
 

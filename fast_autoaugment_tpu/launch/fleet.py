@@ -313,7 +313,8 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
 
 def launch_fleet(hosts: list[str], command: list[str],
                  coordinator: str | None,
-                 env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",),
+                 env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",
+                                                     "FAA_COMPILE_CACHE"),
                  host_retries: int = 0,
                  retry_backoff: float = 1.0,
                  elastic: bool = False,
@@ -428,6 +429,14 @@ def main(argv=None):
                         "DIR/hosts/host<id>.json beat is older than this "
                         "many seconds — the interpreter-level wedge the "
                         "in-process --watchdog cannot catch.  0 = off")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="shared persistent XLA compilation cache: "
+                        "exported to every host (and every RETRY — the "
+                        "relaunch deserializes the executables its "
+                        "predecessor compiled) as FAA_COMPILE_CACHE.  "
+                        "Point it at a directory all hosts mount; the "
+                        "worker CLIs pick it up without extra flags "
+                        "(core/compilecache.py)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run on every host (prefix with --)")
     args = p.parse_args(argv)
@@ -436,6 +445,11 @@ def main(argv=None):
         command = command[1:]
     if not command:
         p.error("no command given")
+    if args.compile_cache and args.compile_cache.lower() != "off":
+        # the env-passthrough list already forwards FAA_COMPILE_CACHE to
+        # every host launch (retries included) — setting it here is the
+        # whole fleet-sharing contract
+        os.environ["FAA_COMPILE_CACHE"] = args.compile_cache
     hosts = expand_hosts(args.hosts)
     code = launch_fleet(hosts, command, args.coordinator,
                         host_retries=args.host_retries,

@@ -247,6 +247,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "passes it; only used when --workqueue is unset)")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
+    p.add_argument("--compile-cache", default="off", metavar="{off,DIR}",
+                   help="persistent XLA compilation cache shared by "
+                        "every compile this search pays (phase-1 "
+                        "training, TTA, audit, phase-3 retrains): a "
+                        "fresh process — exit-77 resume, fleet retry, "
+                        "reclaimed work unit — deserializes its "
+                        "executables from DIR instead of re-paying the "
+                        "23-55s compile tax; hit/miss counts land in "
+                        "search_result.json['compile_cache'].  'off' "
+                        "(default) = historical behavior (still honors "
+                        "an inherited FAA_COMPILE_CACHE; caching never "
+                        "changes numerics).  The fleet launcher's "
+                        "--compile-cache exports the dir to every host")
     p.add_argument("--audit-floor", type=float, default=0.95,
                    help="drop selected sub-policies whose standalone "
                         "mean-over-draws fold accuracy < floor x baseline "
@@ -343,6 +356,7 @@ def _run(args, conf, t_start):
         ckpt_keep=args.ckpt_keep,
         watchdog=args.watchdog,
         work_queue=work_queue,
+        compile_cache=args.compile_cache,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []
@@ -375,6 +389,11 @@ def _run(args, conf, t_start):
         # says what actually measured these hours
         result["device_hours_total"] = hours
         result["tpu_hours_total"] = hours
+        # refresh: phase-3 retrains pay compiles after search_policies
+        # stamped its snapshot
+        from fast_autoaugment_tpu.core.compilecache import compile_cache_stats
+
+        result["compile_cache"] = compile_cache_stats()
         write_json_atomic(
             f"{args.save_dir}/search_result.json",
             {k: v for k, v in result.items() if k not in _UNSERIALIZED})
@@ -457,6 +476,7 @@ def _run(args, conf, t_start):
                 ckpt_keep=args.ckpt_keep,
                 checkpoint_every_dispatch=args.ckpt_every_dispatch,
                 watchdog=args.watchdog, heartbeat=phase3_hb,
+                compile_cache=args.compile_cache,
             )
             outcomes[mode].append(float(res.get("top1_test", 0.0)))
             logger.info("phase3 %s run %d: top1_test=%.4f", mode, run,

@@ -29,28 +29,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fast_autoaugment_tpu.core.compilecache import seam_jit
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
 
 __all__ = ["make_tta_step", "make_audit_step", "eval_tta", "eval_tta_batched"]
 
 
-def _jit_with_trace_counter(fn):
-    """jit `fn` with an explicit trace-event counter attached.
+def _jit_with_trace_counter(fn, label: str):
+    """jit `fn` (through the compile seam) with an explicit trace-event
+    counter attached.
 
     Each retrace of a jitted function corresponds to one new executable
     in its compile cache (a cache hit never re-traces), so counting
     trace events is a public-API-only census of compiles — the fallback
     :func:`search.census.executable_census` uses when jit's private
     ``_cache_size`` disappears in a jax upgrade.  The counter fires at
-    trace time only; it costs nothing on the steady-state call path."""
+    trace time only; it costs nothing on the steady-state call path.
+    The seam (``core/compilecache.py``) times the first-call lowering
+    and classifies it against the persistent compile cache; `label`
+    matches the watchdog's dispatch label for the same entry point."""
     events: list = []
 
     def counted(*args, **kwargs):
         events.append(1)  # trace-time side effect: once per (re)lowering
         return fn(*args, **kwargs)
 
-    jitted = jax.jit(counted)
+    jitted = seam_jit(counted, label=label)
     jitted._faa_trace_count = lambda: len(events)
     return jitted
 
@@ -157,7 +162,7 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
         return score_augmented(params, batch_stats, augmented, labels, mask)
 
     if num_candidates is None:
-        return _jit_with_trace_counter(one_candidate)
+        return _jit_with_trace_counter(one_candidate, "tta")
 
     def tta_step_batched(params, batch_stats, images, labels, mask,
                          policies, keys):
@@ -177,7 +182,7 @@ def make_tta_step(model, *, num_policy: int = 5, cutout_length: int = 16,
                 params, batch_stats, images, labels, mask, pol, k)
         )(policies, keys)
 
-    return _jit_with_trace_counter(tta_step_batched)
+    return _jit_with_trace_counter(tta_step_batched, "tta_batched")
 
 
 def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
@@ -240,7 +245,7 @@ def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
             "cnt": mask.sum().astype(jnp.float32),
         }
 
-    return _jit_with_trace_counter(audit_step)
+    return _jit_with_trace_counter(audit_step, "audit")
 
 
 def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:

@@ -1,0 +1,19 @@
+"""AOT policy-application serving (docs/BENCHMARKS.md "Compile cost &
+cache"; README "Serving a found policy").
+
+The searched policies are only useful if traffic can hit them: this
+package turns a ``final_policy.json`` into a batch-coalescing
+augmentation service backed by ahead-of-time-compiled executables over
+a small set of padded batch shapes — dispatch-only execution in the
+Anakin style (PAPERS.md: *Podracer architectures for scalable RL*),
+with every compile paid at load time through the compile seam
+(``core/compilecache.py``).
+"""
+
+from fast_autoaugment_tpu.serve.policy_server import (
+    AotPolicyApplier,
+    PolicyServer,
+    ServeError,
+)
+
+__all__ = ["AotPolicyApplier", "PolicyServer", "ServeError"]

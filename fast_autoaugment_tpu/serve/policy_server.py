@@ -1,0 +1,524 @@
+"""AOT-compiled policy application + the batch-coalescing server.
+
+Two layers:
+
+:class:`AotPolicyApplier` — the learned policy baked into
+ahead-of-time-compiled executables (``jax.jit(...).lower().compile()``
+through :func:`core.compilecache.aot_compile`) over a SMALL fixed set
+of padded batch shapes.  Compile cost lands entirely at load time (and,
+with the persistent compile cache enabled, is a deserialization after
+the first process); the serving loop only ever dispatches — the
+Anakin/Podracer execution style (PAPERS.md, arXiv:2104.06272).  Two
+kernels from ``ops/augment.py``:
+
+- ``exact``: per-image keys, ``vmap`` of the per-image apply path —
+  :func:`~fast_autoaugment_tpu.ops.augment.apply_policy_scalar_single`
+  for a single-sub policy (scalar ``lax.switch`` dispatch, the fast
+  shape) or :func:`~fast_autoaugment_tpu.ops.augment.apply_policy` for
+  multi-sub.  Lane i depends ONLY on (image i, key i), so padded lanes
+  cannot leak into results by construction, and every served output is
+  bitwise what a direct ``apply_policy(image, policy, key)`` call
+  produces — the contract ``tools/bench_serve.py`` re-verifies per run.
+- ``grouped``: one key per dispatch,
+  :func:`~fast_autoaugment_tpu.ops.augment.apply_policy_batch_grouped`
+  — the PR-3 scalar-dispatch kernel for multi-sub policies (one switch
+  branch executes; stratified per-chunk sub-policy draws with identical
+  per-image marginals).  Served outputs match the grouped kernel run on
+  the same padded batch, sliced to the real rows.
+
+:class:`PolicyServer` — a request-coalescing queue in front of the
+applier: requests accumulate until ``max_batch`` images or
+``max_wait_ms`` after the first arrival, the batch pads UP to the
+smallest AOT shape that holds it, ONE program dispatches, and results
+scatter back to each request in FIFO order.  That is the
+latency/throughput knob heavy traffic needs: big offered load rides the
+large shapes at full device efficiency, a lone request still completes
+within ``max_wait_ms`` + one dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = ["AotPolicyApplier", "PolicyServer", "ServeError",
+           "DEFAULT_SHAPES", "pick_shape"]
+
+logger = get_logger("faa_tpu.serve")
+
+#: padded batch shapes the applier AOT-compiles by default: powers of
+#: four-ish so padding waste stays < 4x at every load level
+DEFAULT_SHAPES = (1, 8, 32, 128)
+
+
+class ServeError(RuntimeError):
+    """A serving dispatch failed; carried to every coalesced request."""
+
+
+def pick_shape(shapes: Sequence[int], n: int) -> int:
+    """The smallest AOT shape holding `n` images (callers chunk at the
+    largest shape first, so `n` <= max(shapes) always)."""
+    for s in shapes:
+        if s >= n:
+            return s
+    raise ValueError(f"batch of {n} exceeds the largest AOT shape "
+                     f"{max(shapes)} — chunk before dispatching")
+
+
+class AotPolicyApplier:
+    """The learned policy as a set of AOT-compiled executables.
+
+    ``policy`` is the ``[num_sub, num_op, 3]`` tensor
+    (``policies.archive.policy_to_tensor``); it is baked into the
+    compiled programs as a constant — a serving process loads ONE
+    policy and serves it, which is what lets XLA fold the op table.
+
+    ``dispatch``: ``"exact"`` / ``"grouped"`` / ``"auto"`` (exact for a
+    single-sub policy — it IS the scalar path there — grouped
+    otherwise).  ``shapes`` are the padded batch sizes compiled,
+    ascending.  ``watchdog`` (optional
+    :class:`~fast_autoaugment_tpu.core.watchdog.DispatchWatchdog`) gets
+    every serving label marked compile-warm — the executables are
+    AOT-loaded, so their first dispatch must not inherit the blind
+    compile allowance.
+    """
+
+    def __init__(self, policy, *, image: int = 32, channels: int = 3,
+                 shapes: Sequence[int] = DEFAULT_SHAPES,
+                 dispatch: str = "auto", groups: int = 8, watchdog=None):
+        import jax
+        import jax.numpy as jnp
+
+        from fast_autoaugment_tpu.core.compilecache import aot_compile
+        from fast_autoaugment_tpu.ops.augment import (
+            apply_policy,
+            apply_policy_batch_grouped,
+            apply_policy_scalar_single,
+        )
+
+        policy = jnp.asarray(np.asarray(policy, np.float32))
+        if policy.ndim != 3 or policy.shape[-1] != 3:
+            raise ValueError(
+                f"policy must be [num_sub, num_op, 3], got {policy.shape}")
+        self.policy = policy
+        self.num_sub = int(policy.shape[0])
+        if dispatch == "auto":
+            dispatch = "exact" if self.num_sub == 1 else "grouped"
+        if dispatch not in ("exact", "grouped"):
+            raise ValueError(f"dispatch must be exact/grouped/auto, "
+                             f"got {dispatch!r}")
+        self.dispatch = dispatch
+        self.groups = max(1, int(groups))
+        self.image, self.channels = int(image), int(channels)
+        self.shapes = tuple(sorted(set(int(s) for s in shapes)))
+        if not self.shapes or self.shapes[0] < 1:
+            raise ValueError(f"need at least one positive shape, "
+                             f"got {shapes!r}")
+        self.max_batch = self.shapes[-1]
+        self._watchdog = watchdog
+
+        if dispatch == "exact":
+            per_image = (apply_policy_scalar_single if self.num_sub == 1
+                         else apply_policy)
+
+            def kernel(images, keys):
+                return jax.vmap(per_image, in_axes=(0, None, 0))(
+                    images, policy, keys)
+        else:
+            def kernel(images, key):
+                return apply_policy_batch_grouped(
+                    images, policy, key, groups=self.groups)
+
+        #: per-shape compile evidence: {shape: {"sec", "verdict"}} — the
+        #: bench stamps it next to the compile_cache block
+        self.compile_log: dict[int, dict] = {}
+        self._exec: dict[int, object] = {}
+        img_dt = jnp.float32
+        for s in self.shapes:
+            spec_img = jax.ShapeDtypeStruct(
+                (s, self.image, self.image, self.channels), img_dt)
+            if dispatch == "exact":
+                spec_key = jax.ShapeDtypeStruct((s, 2), jnp.uint32)
+            else:
+                spec_key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            label = f"serve_{dispatch}_b{s}"
+            self._exec[s], rec = aot_compile(
+                kernel, label=label, example_args=(spec_img, spec_key))
+            self.compile_log[s] = rec
+            if watchdog is not None:
+                # AOT-loaded: the first dispatch is compile-free and
+                # must not hide behind the 600s compile window
+                watchdog.mark_compile_warm(label)
+        logger.info(
+            "AOT policy applier ready: %d sub-policies, dispatch=%s, "
+            "shapes=%s, compile %s",
+            self.num_sub, dispatch, list(self.shapes),
+            {s: r["sec"] for s, r in self.compile_log.items()})
+
+    def _pad(self, arr: np.ndarray, target: int) -> np.ndarray:
+        pad = target - arr.shape[0]
+        if pad <= 0:
+            return arr
+        return np.concatenate(
+            [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
+
+    def apply(self, images: np.ndarray, keys: np.ndarray) -> np.ndarray:
+        """Apply the policy to ``images [n, H, W, C]`` (uint8 or
+        integral float32 in [0, 255]).
+
+        ``exact`` dispatch: `keys` is ``[n, 2]`` uint32 — one PRNG key
+        per image; row i of the output is bitwise
+        ``apply_policy(images[i], policy, keys[i])``.  ``grouped``
+        dispatch: `keys` is a single ``[2]`` key for the whole
+        dispatch.  Batches larger than the largest AOT shape are
+        chunked; smaller ones pad up (zero images / zero keys in the
+        padded lanes, results sliced away).  Returns float32
+        integral-valued images.
+        """
+        images = np.asarray(images)
+        if images.ndim != 4:
+            raise ValueError(f"images must be [n, H, W, C], got "
+                             f"{images.shape}")
+        expect = (self.image, self.image, self.channels)
+        if images.shape[1:] != expect:
+            raise ValueError(
+                f"images are {images.shape[1:]}, this applier serves "
+                f"{expect} — resize/crop client-side")
+        images = images.astype(np.float32, copy=False)
+        keys = np.asarray(keys, np.uint32)
+        out = np.empty_like(images)
+        n = images.shape[0]
+        lo, chunk_idx = 0, 0
+        while lo < n:
+            hi = min(lo + self.max_batch, n)
+            if self.dispatch == "exact":
+                k = keys[lo:hi]
+            elif chunk_idx == 0:
+                k = keys
+            else:
+                # over-large grouped batches: fresh program key per
+                # chunk, or every chunk would replay one permutation
+                import jax
+
+                k = np.asarray(jax.random.fold_in(keys, chunk_idx),
+                               np.uint32)
+            out[lo:hi] = self._apply_one(images[lo:hi], k)
+            lo = hi
+            chunk_idx += 1
+        return out
+
+    def _apply_one(self, images: np.ndarray, keys: np.ndarray) -> np.ndarray:
+        n = images.shape[0]
+        s = pick_shape(self.shapes, n)
+        padded = self._pad(images, s)
+        if self.dispatch == "exact":
+            keys = self._pad(np.asarray(keys, np.uint32).reshape(n, 2), s)
+        fn = self._exec[s]
+        label = f"serve_{self.dispatch}_b{s}"
+        if self._watchdog is not None and self._watchdog.enabled:
+            got = self._watchdog.run(label, fn, padded, keys)
+        else:
+            got = fn(padded, keys)
+        return np.asarray(got)[:n]
+
+    # ------------------------------------------------ export round-trip
+
+    def export_serialized(self, shape: int | None = None) -> bytes:
+        """``jax.export`` serialization of one shape's program — the
+        ship-an-executable story (a consumer process calls
+        :func:`deserialize_apply` without this package's tracing code).
+        Defaults to the largest shape."""
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
+        from fast_autoaugment_tpu.ops.augment import (
+            apply_policy,
+            apply_policy_batch_grouped,
+            apply_policy_scalar_single,
+        )
+
+        s = self.shapes[-1] if shape is None else int(shape)
+        if s not in self._exec:
+            raise KeyError(f"shape {s} not compiled (have {self.shapes})")
+        policy = self.policy
+        if self.dispatch == "exact":
+            per_image = (apply_policy_scalar_single if self.num_sub == 1
+                         else apply_policy)
+
+            def kernel(images, keys):
+                return jax.vmap(per_image, in_axes=(0, None, 0))(
+                    images, policy, keys)
+
+            spec_key = jax.ShapeDtypeStruct((s, 2), jnp.uint32)
+        else:
+            groups = self.groups
+
+            def kernel(images, key):
+                return apply_policy_batch_grouped(images, policy, key,
+                                                  groups=groups)
+
+            spec_key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        spec_img = jax.ShapeDtypeStruct(
+            (s, self.image, self.image, self.channels), jnp.float32)
+        # jax.export needs the raw jitted fn; it lowers without running,
+        # so there is no first call for the seam to time
+        exported = jax_export.export(jax.jit(kernel))(spec_img, spec_key)  # robust: allow
+        return exported.serialize()
+
+
+def deserialize_apply(blob: bytes):
+    """Rehydrate an :meth:`AotPolicyApplier.export_serialized` program:
+    returns ``fn(images, keys) -> images`` at the exported padded
+    shape."""
+    from jax import export as jax_export
+
+    exported = jax_export.deserialize(blob)
+    return lambda images, keys: exported.call(images, keys)
+
+
+class _Pending:
+    """One in-flight request: `n` images, completion event, result or
+    error, submit/done walls for the latency record."""
+
+    __slots__ = ("images", "keys", "event", "result", "error",
+                 "t_submit", "t_done")
+
+    def __init__(self, images: np.ndarray, keys: np.ndarray | None):
+        self.images = images
+        self.keys = keys
+        self.event = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.t_submit = time.perf_counter()
+        self.t_done = 0.0
+
+    @property
+    def n(self) -> int:
+        return self.images.shape[0]
+
+    def latency(self) -> float:
+        return self.t_done - self.t_submit
+
+
+class PolicyServer:
+    """Batch-coalescing request front for an :class:`AotPolicyApplier`.
+
+    The worker collects requests until ``max_batch`` images are queued
+    or ``max_wait_ms`` has passed since the FIRST queued request, pads
+    to the nearest AOT shape, dispatches one program and scatters the
+    rows back in FIFO order.  A request that would overflow the batch
+    is carried to the next dispatch intact (requests are never split,
+    so per-request key streams stay contiguous).
+    """
+
+    def __init__(self, applier: AotPolicyApplier, *,
+                 max_batch: int | None = None, max_wait_ms: float = 5.0,
+                 queue_depth: int = 4096, seed: int = 0):
+        self.applier = applier
+        self.max_batch = int(max_batch or applier.max_batch)
+        if self.max_batch > applier.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the largest AOT "
+                f"shape {applier.max_batch}")
+        self.max_wait_ms = float(max_wait_ms)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._carry: _Pending | None = None
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+        self._seed = int(seed)
+        self._auto_key_counter = 0
+        self._lock = threading.Lock()
+        # serving accounting for the bench/stats endpoints
+        self.dispatches = 0
+        self.requests = 0
+        self.images_served = 0
+        self.batch_sizes: list[int] = []
+        self.dispatch_walls: list[float] = []
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "PolicyServer":
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="policy-server")
+        self._worker.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            # bounded join (lint R4): a wedged dispatch must not hang
+            # shutdown — the worker is a daemon either way
+            self._worker.join(timeout=timeout)
+
+    # --------------------------------------------------------- clients
+
+    def _auto_keys(self, n: int) -> np.ndarray:
+        """Server-derived per-image keys: ``fold_in(PRNGKey(seed), i)``
+        over a process-monotonic counter — distinct stream per image
+        without client coordination."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            base = self._auto_key_counter
+            self._auto_key_counter += n
+        root = jax.random.PRNGKey(self._seed)
+        idx = jnp.arange(base, base + n)
+        return np.asarray(
+            jax.vmap(lambda i: jax.random.fold_in(root, i))(idx), np.uint32)
+
+    def submit(self, images: np.ndarray,
+               keys: np.ndarray | None = None) -> _Pending:
+        """Queue ``images [n, H, W, C]`` (or one ``[H, W, C]`` image).
+
+        `keys` (``[n, 2]`` uint32) pins the per-image PRNG streams —
+        the reproducible-serving contract; None lets the server derive
+        them.  Returns a pending handle for :meth:`result`."""
+        images = np.asarray(images)
+        if images.ndim == 3:
+            images = images[None]
+        n = images.shape[0]
+        if n < 1:
+            raise ValueError("empty request")
+        if n > self.max_batch:
+            raise ValueError(
+                f"request of {n} images exceeds max_batch "
+                f"{self.max_batch} — split client-side")
+        if keys is None and self.applier.dispatch == "exact":
+            keys = self._auto_keys(n)
+        elif keys is not None:
+            keys = np.asarray(keys, np.uint32).reshape(n, 2)
+        pending = _Pending(images, keys)
+        self._q.put(pending, timeout=30.0)
+        return pending
+
+    def result(self, pending: _Pending, timeout: float = 60.0) -> np.ndarray:
+        """Block for a submitted request's augmented images."""
+        if not pending.event.wait(timeout=timeout):
+            raise TimeoutError(
+                f"no result within {timeout}s ({pending.n} images)")
+        if pending.error is not None:
+            raise ServeError(str(pending.error)) from pending.error
+        return pending.result
+
+    def augment(self, images: np.ndarray, keys: np.ndarray | None = None,
+                timeout: float = 60.0) -> np.ndarray:
+        """Submit + wait — the one-call client path."""
+        return self.result(self.submit(images, keys), timeout=timeout)
+
+    # ---------------------------------------------------------- worker
+
+    def _take_first(self) -> _Pending | None:
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+            return first
+        try:
+            # bounded get: the stop flag is polled between waits
+            return self._q.get(timeout=0.05)
+        except queue.Empty:
+            return None
+
+    def _collect(self, first: _Pending) -> list[_Pending]:
+        """Coalesce: up to ``max_batch`` images or ``max_wait_ms`` after
+        the FIRST request of the batch arrived."""
+        batch = [first]
+        count = first.n
+        deadline = time.perf_counter() + self.max_wait_ms / 1e3
+        while count < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if count + nxt.n > self.max_batch:
+                # never split a request: carry it whole to the next
+                # dispatch (FIFO preserved — the carry is taken first)
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            count += nxt.n
+        return batch
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        images = np.concatenate([p.images for p in batch])
+        if self.applier.dispatch == "exact":
+            keys = np.concatenate([p.keys for p in batch])
+        else:
+            # one program key per dispatch, derived server-side
+            keys = self._auto_keys(1)[0]
+        t0 = time.perf_counter()
+        try:
+            out = self.applier.apply(images, keys)
+        except Exception as e:  # noqa: BLE001 — delivered to every caller
+            logger.error("serving dispatch failed (%d images): %s",
+                         images.shape[0], e)
+            for p in batch:
+                p.error = e
+                p.t_done = time.perf_counter()
+                p.event.set()
+            return
+        wall = time.perf_counter() - t0
+        lo = 0
+        done = time.perf_counter()
+        for p in batch:
+            p.result = out[lo:lo + p.n]
+            lo += p.n
+            p.t_done = done
+            p.event.set()
+        with self._lock:
+            self.dispatches += 1
+            self.requests += len(batch)
+            self.images_served += images.shape[0]
+            self.batch_sizes.append(images.shape[0])
+            self.dispatch_walls.append(wall)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            first = self._take_first()
+            if first is None:
+                continue
+            self._dispatch(self._collect(first))
+        # drain on stop: in-flight clients must not hang forever
+        leftovers = [self._carry] if self._carry is not None else []
+        self._carry = None
+        while True:
+            try:
+                leftovers.append(self._q.get(timeout=0.01))
+            except queue.Empty:
+                break
+        for p in leftovers:
+            p.error = ServeError("server stopped")
+            p.t_done = time.perf_counter()
+            p.event.set()
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            sizes = list(self.batch_sizes)
+            walls = list(self.dispatch_walls)
+            out = {
+                "dispatches": self.dispatches,
+                "requests": self.requests,
+                "images_served": self.images_served,
+                "max_batch": self.max_batch,
+                "max_wait_ms": self.max_wait_ms,
+                "dispatch": self.applier.dispatch,
+                "shapes": list(self.applier.shapes),
+            }
+        if sizes:
+            out["mean_batch"] = round(float(np.mean(sizes)), 2)
+            out["mean_dispatch_ms"] = round(float(np.mean(walls)) * 1e3, 3)
+        return out

@@ -1,0 +1,214 @@
+"""Policy-serving CLI — turn a ``final_policy.json`` into an HTTP
+augmentation endpoint.
+
+    python -m fast_autoaugment_tpu.serve.serve_cli \
+        --policy search_out/final_policy.json --image 32 \
+        --compile-cache /shared/xla-cache --port 8765
+
+Loads the learned policy, AOT-compiles the application kernels over the
+padded batch shapes (through the compile seam — with ``--compile-cache``
+a restarted server deserializes them in seconds), and serves:
+
+- ``POST /augment`` — body is an ``.npz`` with ``images``
+  (``[n, H, W, C]`` uint8 or float32) and optionally ``seeds``
+  (``[n]`` int, pinning per-image PRNG streams for reproducible
+  serving).  Response is an ``.npz`` with the augmented ``images``
+  (uint8).  Requests from concurrent clients COALESCE into shared
+  device dispatches (:class:`~fast_autoaugment_tpu.serve.PolicyServer`).
+- ``GET /stats`` — serving accounting + the ``compile_cache`` stamp.
+- ``GET /healthz`` — liveness.
+
+``tools/bench_serve.py`` (``make bench-serve``) measures the in-process
+latency/throughput envelope of the same applier/server pair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+logger = get_logger("faa_tpu.serve_cli")
+
+
+def build_policy_tensor(spec: str) -> np.ndarray:
+    """``--policy`` -> [num_sub, num_op, 3] tensor.
+
+    Accepts a path to a ``final_policy.json`` (the search's decoded
+    sub-policy list) or a shipped archive name
+    (``policies/archive.py``, e.g. ``fa_reduced_cifar10``)."""
+    import os
+
+    from fast_autoaugment_tpu.policies.archive import (
+        load_policy,
+        policy_to_tensor,
+    )
+
+    if os.path.exists(spec):
+        with open(spec) as fh:
+            raw = json.load(fh)
+        if not raw:
+            raise ValueError(f"{spec} holds an empty policy set")
+        subs = [[(str(op), float(p), float(lv)) for op, p, lv in sub]
+                for sub in raw]
+        return np.asarray(policy_to_tensor(subs), np.float32)
+    return np.asarray(policy_to_tensor(load_policy(spec)), np.float32)
+
+
+def _seed_keys(seeds) -> np.ndarray:
+    """Per-image seeds -> [n, 2] uint32 PRNG keys (one PRNGKey per
+    seed — the reproducible-serving contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    seeds = jnp.asarray(np.asarray(seeds, np.int64) & 0x7FFFFFFF,
+                        jnp.uint32)
+    return np.asarray(jax.vmap(jax.random.PRNGKey)(seeds), np.uint32)
+
+
+def make_handler(server, applier):
+    """The request handler bound to one PolicyServer instance."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.info("http: " + fmt, *args)
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, obj) -> None:
+            self._send(code, json.dumps(obj).encode(), "application/json")
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send_json(200, {"ok": True})
+                return
+            if self.path == "/stats":
+                from fast_autoaugment_tpu.core.compilecache import (
+                    compile_cache_stats,
+                )
+
+                stats = server.stats()
+                stats["compile_cache"] = compile_cache_stats()
+                stats["aot_compile"] = {
+                    str(s): r for s, r in applier.compile_log.items()}
+                self._send_json(200, stats)
+                return
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/augment":
+                self._send_json(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = np.load(io.BytesIO(self.rfile.read(length)),
+                                  allow_pickle=False)
+                images = np.asarray(payload["images"])
+                if images.ndim == 3:
+                    images = images[None]
+                keys = None
+                if "seeds" in payload.files:
+                    keys = _seed_keys(payload["seeds"])
+                out = server.augment(images, keys)
+            except (KeyError, ValueError, OSError) as e:
+                self._send_json(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except TimeoutError as e:
+                self._send_json(503, {"error": str(e)})
+                return
+            buf = io.BytesIO()
+            np.savez(buf, images=np.clip(out, 0, 255).astype(np.uint8))
+            self._send(200, buf.getvalue(), "application/octet-stream")
+
+    return Handler
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="fast-autoaugment-tpu policy-serving endpoint")
+    p.add_argument("--policy", required=True,
+                   help="final_policy.json path or a shipped archive name")
+    p.add_argument("--image", type=int, default=32,
+                   help="served image resolution (client resizes)")
+    p.add_argument("--shapes", default="1,8,32,128",
+                   help="comma-separated padded batch shapes to AOT-compile")
+    p.add_argument("--dispatch", default="auto",
+                   choices=("auto", "exact", "grouped"),
+                   help="policy-application kernel: 'exact' = per-image "
+                        "keys, bitwise apply_policy per lane; 'grouped' = "
+                        "scalar-dispatch batch kernel (one switch branch "
+                        "executes); 'auto' (default) = exact for a "
+                        "single-sub policy, grouped otherwise")
+    p.add_argument("--groups", type=int, default=8,
+                   help="chunk count for the grouped kernel")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="coalescer cap (default: the largest AOT shape)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="coalescing window after the first queued request")
+    p.add_argument("--compile-cache", default="off", metavar="{off,DIR}",
+                   help="persistent XLA compilation cache: a restarted "
+                        "server deserializes its AOT executables from DIR "
+                        "instead of re-lowering them (core/compilecache.py)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8765)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from fast_autoaugment_tpu.core.compilecache import (
+        compile_cache_stats,
+        configure_compile_cache,
+    )
+    from fast_autoaugment_tpu.serve.policy_server import (
+        AotPolicyApplier,
+        PolicyServer,
+    )
+
+    configure_compile_cache(args.compile_cache)
+    policy = build_policy_tensor(args.policy)
+    shapes = tuple(int(s) for s in str(args.shapes).split(",") if s)
+    applier = AotPolicyApplier(policy, image=args.image, shapes=shapes,
+                               dispatch=args.dispatch, groups=args.groups)
+    server = PolicyServer(applier, max_batch=args.max_batch,
+                          max_wait_ms=args.max_wait_ms).start()
+    cc = compile_cache_stats()
+    logger.info(
+        "serving %d sub-policies (dispatch=%s) at http://%s:%d — AOT "
+        "compile paid up front (%s; cache hits=%d misses=%d)",
+        applier.num_sub, applier.dispatch, args.host, args.port,
+        {s: r["sec"] for s, r in applier.compile_log.items()},
+        cc["hits"], cc["misses"])
+
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                make_handler(server, applier))
+
+    def shutdown(signum, frame):
+        logger.info("signal %d: shutting down", signum)
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from fast_autoaugment_tpu.core.compilecache import seam_jit
 from fast_autoaugment_tpu.core.metrics import (
     mixup_batch,
     mixup_cross_entropy,
@@ -226,8 +227,10 @@ def make_train_step(
         aug_dispatch=aug_dispatch, aug_groups=aug_groups,
     )
     # donate the state: params/opt-state/EMA buffers are overwritten in
-    # place, halving peak HBM for the update
-    return functools.partial(jax.jit, donate_argnums=(0,))(body)
+    # place, halving peak HBM for the update.  Jitted through the
+    # compile seam (core/compilecache.py): first-call compile is timed
+    # and classified hit/miss against the persistent cache.
+    return seam_jit(body, label="train_step", donate_argnums=(0,))
 
 
 def make_stacked_step_body(
@@ -355,7 +358,7 @@ def make_stacked_train_step(
         use_policy=use_policy, augment_fn=augment_fn,
         aug_dispatch=aug_dispatch, aug_groups=aug_groups,
     )
-    return functools.partial(jax.jit, donate_argnums=(0,))(body)
+    return seam_jit(body, label="stacked_step", donate_argnums=(0,))
 
 
 def default_dispatch_unroll(steps_per_dispatch: int) -> int:
@@ -459,7 +462,11 @@ def make_multistep_train_step(
                                            unroll=unroll)
             return states, jax.tree.map(lambda v: v.sum(axis=0), metrics)
 
-    return functools.partial(jax.jit, donate_argnums=(0,))(multi_fn)
+    # seam labels match the watchdog's dispatch labels so the compile
+    # evidence and the deadline evidence line up per entry point
+    return seam_jit(multi_fn,
+                    label="stacked_dispatch" if stacked else "train_dispatch",
+                    donate_argnums=(0,))
 
 
 def stack_states(states: list[TrainState]) -> TrainState:
@@ -508,9 +515,9 @@ def make_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
                    preprocess_fn: Callable | None = None) -> Callable:
     """Build the jitted eval step: ``fn(params, batch_stats, images_u8,
     labels, mask) -> metric_sums`` (loss/top1/top5/num as sums)."""
-    return jax.jit(_make_eval_body(
+    return seam_jit(_make_eval_body(
         model, num_classes=num_classes, lb_smooth=lb_smooth,
-        preprocess_fn=preprocess_fn))
+        preprocess_fn=preprocess_fn), label="eval_step")
 
 
 def make_replay_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
@@ -537,7 +544,6 @@ def make_replay_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
     body = _make_eval_body(model, num_classes=num_classes,
                            lb_smooth=lb_smooth, preprocess_fn=preprocess_fn)
 
-    @jax.jit
     def replay_fn(params, batch_stats, images, labels, masks):
         def one(carry, batch):
             x, y, m = batch
@@ -546,4 +552,4 @@ def make_replay_eval_step(model, *, num_classes: int, lb_smooth: float = 0.0,
         _, sums = jax.lax.scan(one, jnp.zeros(()), (images, labels, masks))
         return jax.tree.map(lambda v: v.sum(axis=0), sums)
 
-    return replay_fn
+    return seam_jit(replay_fn, label="replay_eval")

@@ -31,6 +31,10 @@ from fast_autoaugment_tpu.core.checkpoint import (
     read_metadata,
     save_checkpoint,
 )
+from fast_autoaugment_tpu.core.compilecache import (
+    compile_cache_stats,
+    configure_compile_cache,
+)
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.core.resilience import (
     PREEMPTED_EXIT_CODE,
@@ -235,6 +239,7 @@ def train_and_eval(
     checkpoint_every_dispatch: int = 0,
     watchdog="off",
     heartbeat: Callable | None = None,
+    compile_cache: str = "off",
 ) -> dict:
     """Train (or just evaluate) one model under `conf`.
 
@@ -282,7 +287,17 @@ def train_and_eval(
     e.g. a work-queue lease renewal) is invoked at every dispatch-chunk
     boundary (cache path) and epoch boundary — a raised
     ``LeaseLostError`` propagates and aborts the unit.
+
+    ``compile_cache`` ("off" default / a directory) points JAX's
+    persistent compilation cache at a shared dir so a fresh process —
+    an exit-77 resume, a fleet retry, a reclaimed work unit — reaches
+    its first step in seconds instead of re-paying the 23-55 s compile
+    tax (``core/compilecache.py``; "off" still honors an inherited
+    ``FAA_COMPILE_CACHE``).  Caching never changes numerics — only
+    where executables come from; the result carries the evidence under
+    ``result['compile_cache']``.
     """
+    cache_dir_active = configure_compile_cache(compile_cache)
     if mesh is None:
         mesh = make_mesh()
     is_master = jax.process_index() == 0
@@ -471,7 +486,12 @@ def train_and_eval(
                     {"params": state.params, "batch_stats": state.batch_stats},
                 )
             state = state.replace(**fixes)
-        logger.info("resumed %s at epoch %d", used_path, epoch_start - 1)
+        # resume-cost provenance: whether this resumed process will
+        # deserialize its executables (warm cache) or re-pay the full
+        # compile tax — the final compile_cache stamp carries the proof
+        logger.info("resumed %s at epoch %d (compile cache: %s)",
+                    used_path, epoch_start - 1,
+                    cache_dir_active or "off — full recompile ahead")
         if epoch_start > epochs:
             only_eval = True
     elif only_eval and save_path:
@@ -538,6 +558,7 @@ def train_and_eval(
             for k, v in m.items():
                 result[f"{k}_{split}"] = v
         result["epoch"] = epoch_start - 1
+        result["compile_cache"] = compile_cache_stats()
         return result
 
     # best-metric guards live AFTER the only_eval return (eval-only runs
@@ -833,6 +854,10 @@ def train_and_eval(
         epoch += 1
 
     result["elapsed_sec"] = time.time() - t_start
+    # compile-tax evidence (hit/miss counts + per-label first-call
+    # seconds through the seam): a resumed/warm process proves here
+    # that it reached its first step in seconds, not minutes
+    result["compile_cache"] = compile_cache_stats()
     for w in writers:
         w.close()
     return result
@@ -857,6 +882,7 @@ def train_folds_stacked(
     ckpt_keep: int = 2,
     watchdog="off",
     heartbeat: Callable | None = None,
+    compile_cache: str = "off",
 ) -> dict[int, dict]:
     """Train K phase-1 fold models as ONE vmapped program per step.
 
@@ -912,6 +938,7 @@ def train_folds_stacked(
     (deadline-guarded dispatches; lease renewal per dispatch/epoch
     boundary).
     """
+    configure_compile_cache(compile_cache)
     if len(folds) != len(save_paths):
         raise ValueError(f"{len(folds)} folds but {len(save_paths)} paths")
     num_folds = len(folds)
@@ -1325,8 +1352,13 @@ def train_folds_stacked(
             raise PreemptedError(f"stacked preempted after epoch {epoch}")
 
     elapsed = time.time() - t_start
+    cc = compile_cache_stats()
+    logger.info("stacked: compile cache dir=%s hits=%d misses=%d "
+                "first_step_secs=%.3f", cc["dir"], cc["hits"], cc["misses"],
+                cc["first_step_secs"])
     for k, fold in enumerate(folds):
         results[fold]["elapsed_sec"] = elapsed
+        results[fold]["compile_cache"] = cc
         for w in writers[k]:
             w.close()
     return results

@@ -1,0 +1,402 @@
+"""Persistent compile cache + compile seam (core/compilecache.py).
+
+Fast tests cover spec resolution, seam classification/delegation, the
+watchdog warm-allowance coupling and the bench probe memo — all host
+side or one tiny compile.  The slow tests are the acceptance drills:
+a warm SECOND PROCESS reports cache hits and a fast first step, a
+config change goes cold again, cached-vs-fresh executables train
+bit-identically, and the exit-77 resume e2e reports a cache hit.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.core import compilecache as cc
+from fast_autoaugment_tpu.core.watchdog import DispatchWatchdog
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def clean_cache_state(monkeypatch, tmp_path):
+    """Zero the seam stats and guarantee the process-global cache
+    config is restored after the test — enabling the cache is
+    process-wide state the rest of the suite must not inherit."""
+    monkeypatch.delenv(cc.ENV_VAR, raising=False)
+    cc._reset_stats_for_tests()
+    cc._disable_for_tests()
+    yield tmp_path
+    cc._reset_stats_for_tests()
+    cc._disable_for_tests()
+
+
+# ---------------------------------------------------- spec resolution
+
+
+def test_resolve_off_and_dir(monkeypatch):
+    monkeypatch.delenv(cc.ENV_VAR, raising=False)
+    assert cc.resolve_compile_cache(None) is None
+    assert cc.resolve_compile_cache("off") is None
+    assert cc.resolve_compile_cache("OFF") is None
+    assert cc.resolve_compile_cache("/x/y") == "/x/y"
+
+
+def test_resolve_env_fallback_and_precedence(monkeypatch):
+    monkeypatch.setenv(cc.ENV_VAR, "/from/env")
+    # "off"/unset spec falls back to the env handoff (fleet contract)
+    assert cc.resolve_compile_cache(None) == "/from/env"
+    assert cc.resolve_compile_cache("off") == "/from/env"
+    # an explicit dir wins over the env
+    assert cc.resolve_compile_cache("/explicit") == "/explicit"
+    monkeypatch.setenv(cc.ENV_VAR, "off")
+    assert cc.resolve_compile_cache(None) is None
+
+
+def test_enable_exports_env_for_children(clean_cache_state):
+    d = str(clean_cache_state / "cache")
+    got = cc.configure_compile_cache(d)
+    assert got == os.path.abspath(d)
+    assert os.path.isdir(d)
+    # children (fleet hosts, exit-77 relaunches) inherit via the env
+    assert os.environ[cc.ENV_VAR] == os.path.abspath(d)
+    assert cc.cache_dir() == os.path.abspath(d)
+
+
+# ------------------------------------------------------- seam wrapper
+
+
+def test_seam_uncached_classification_and_stats(clean_cache_state):
+    import jax.numpy as jnp
+
+    fn = cc.seam_jit(lambda x: x * 2 + 1, label="t_uncached")
+    out = fn(jnp.ones((4,)))
+    assert np.allclose(np.asarray(out), 3.0)
+    stats = cc.compile_cache_stats()
+    assert stats["enabled"] is False and stats["dir"] is None
+    assert stats["labels"]["t_uncached"]["uncached"] == 1
+    assert stats["labels"]["t_uncached"]["sec"] > 0
+    assert stats["first_step_secs"] >= stats["labels"]["t_uncached"]["sec"]
+    # second call is not re-recorded
+    fn(jnp.ones((4,)))
+    assert cc.compile_cache_stats()["labels"]["t_uncached"]["uncached"] == 1
+
+
+def test_seam_delegates_lower_and_attributes(clean_cache_state):
+    import jax.numpy as jnp
+
+    fn = cc.seam_jit(lambda x: x + 1, label="t_deleg")
+    # bench.py AOT-lowers through .lower on the seam wrapper
+    compiled = fn.lower(jnp.ones((2,))).compile()
+    assert np.allclose(np.asarray(compiled(jnp.ones((2,)))), 2.0)
+    # census probes _cache_size through the wrapper (attribute
+    # delegation); attaching attributes works too (tta trace counter)
+    fn._faa_trace_count = lambda: 7
+    assert fn._faa_trace_count() == 7
+
+
+def test_seam_hit_miss_in_process(clean_cache_state):
+    """Enable the cache, compile a fn (miss), re-jit an IDENTICAL but
+    distinct fn (hit: a distinct function identity bypasses jax's
+    in-memory tracing caches, so the compile reaches the persistent
+    layer and deserializes — the same path a fresh process takes)."""
+    import jax.numpy as jnp
+
+    cc.configure_compile_cache(str(clean_cache_state / "cache"))
+
+    def make_body():
+        def body(x):
+            return (x * 3).sum() + 1
+        return body
+
+    a = cc.seam_jit(make_body(), label="t_cold")
+    a(jnp.ones((8, 8)))
+    stats = cc.compile_cache_stats()
+    assert stats["misses"] > 0
+    assert stats["labels"]["t_cold"]["miss"] == 1
+
+    b = cc.seam_jit(make_body(), label="t_warm")
+    b(jnp.ones((8, 8)))
+    stats = cc.compile_cache_stats()
+    assert stats["hits"] > 0
+    assert stats["labels"]["t_warm"]["hit"] == 1
+    # at least one persistent entry landed on disk
+    assert any(f.endswith("-cache")
+               for f in os.listdir(cc.cache_dir()))
+
+
+# --------------------------------------- watchdog warm-allowance coupling
+
+
+def test_watchdog_first_call_blind_window_when_cold():
+    wd = DispatchWatchdog("auto", compile_allowance=600.0)
+    assert wd.deadline("train_dispatch") == 600.0
+
+
+def test_watchdog_shrinks_first_call_when_process_warm(monkeypatch):
+    wd = DispatchWatchdog("auto", compile_allowance=600.0,
+                          warm_allowance=45.0)
+    monkeypatch.setattr(cc, "process_is_warm", lambda: True)
+    # the seam has proven the cache warm: no blind 600s window
+    assert wd.deadline("train_dispatch") == 45.0
+    # steady state is untouched
+    wd.observe("train_dispatch", 2.0)
+    assert wd.deadline("train_dispatch") == pytest.approx(40.0)
+
+
+def test_watchdog_mark_compile_warm_fixed_mode():
+    wd = DispatchWatchdog(5.0, compile_allowance=600.0)
+    assert wd.deadline("serve_exact_b8") == 600.0
+    wd.mark_compile_warm("serve_exact_b8")
+    # AOT-loaded executable: first dispatch gets the NORMAL deadline
+    assert wd.deadline("serve_exact_b8") == 5.0
+    assert "serve_exact_b8" in wd.stats()["warm_labels"]
+
+
+def test_watchdog_warm_floor_respects_min_deadline():
+    wd = DispatchWatchdog("auto", warm_allowance=1.0, min_deadline=10.0)
+    wd.mark_compile_warm("d")
+    assert wd.deadline("d") == 10.0
+
+
+# --------------------------------------------------- bench probe memo
+
+
+def test_probe_memo_roundtrip_and_ttl(tmp_path, monkeypatch):
+    import bench
+
+    memo = tmp_path / "probe.json"
+    monkeypatch.setenv("FAA_PROBE_MEMO_PATH", str(memo))
+    assert bench._read_probe_memo(600) is None  # no memo yet
+    bench._write_probe_memo("dead")
+    assert bench._read_probe_memo(600) == "dead"
+    assert bench._read_probe_memo(0) is None  # ttl 0 disables
+    # a stale memo is ignored
+    rec = json.loads(memo.read_text())
+    rec["ts"] -= 10_000
+    memo.write_text(json.dumps(rec))
+    assert bench._read_probe_memo(600) is None
+    # a torn memo is ignored, not fatal
+    memo.write_text("{not json")
+    assert bench._read_probe_memo(600) is None
+
+
+def test_probe_memo_short_circuits_retry_window(tmp_path, monkeypatch):
+    """A fresh 'dead' verdict skips the whole probe-retry window (the
+    11-minute tax BENCH_r05 paid per bench round) and goes straight to
+    the CPU fallback re-exec; 'alive' skips the probe and returns."""
+    import bench
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("FAA_PROBE_MEMO_PATH", str(tmp_path / "probe.json"))
+    monkeypatch.delenv("FAA_SKIP_TPU_PROBE", raising=False)
+    probes = []
+    monkeypatch.setattr(bench, "_probe_backend_once",
+                        lambda t: probes.append(t) or -1)
+    execs = []
+    monkeypatch.setattr(bench.os, "execvpe",
+                        lambda *a: execs.append(a))
+
+    bench._write_probe_memo("alive")
+    bench._ensure_live_backend()
+    assert not probes and not execs  # memoized alive: no probe at all
+
+    bench._write_probe_memo("dead")
+    bench._ensure_live_backend(reexec_argv=["python", "x"])
+    assert not probes  # memoized dead: no retry window either
+    assert len(execs) == 1  # straight to the CPU fallback
+    assert execs[0][2]["JAX_PLATFORMS"] == "cpu"
+
+
+def test_probe_skip_env(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("FAA_SKIP_TPU_PROBE", "1")
+    monkeypatch.setattr(bench, "_probe_backend_once",
+                        lambda t: (_ for _ in ()).throw(AssertionError))
+    bench._ensure_live_backend()  # returns without probing or exec
+
+
+def test_probe_writes_memo_after_real_probe(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    memo = tmp_path / "probe.json"
+    monkeypatch.setenv("FAA_PROBE_MEMO_PATH", str(memo))
+    monkeypatch.delenv("FAA_SKIP_TPU_PROBE", raising=False)
+    monkeypatch.setenv("FAA_BENCH_RETRY_WINDOW", "0")
+    monkeypatch.setattr(bench, "_probe_backend_once", lambda t: 0)
+    bench._ensure_live_backend()
+    assert json.loads(memo.read_text())["verdict"] == "alive"
+
+
+# ---------------------------------------------------- bench stamp block
+
+
+def test_bench_compile_cache_stamp_schema(clean_cache_state):
+    import bench
+
+    stamp = bench.compile_cache_stamp()
+    for key in ("dir", "enabled", "hits", "misses", "first_step_secs",
+                "labels"):
+        assert key in stamp, key
+
+
+# -------------------------------------------------- subprocess drills
+
+_CHILD = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax, jax.numpy as jnp, numpy as np
+from fast_autoaugment_tpu.core.compilecache import (
+    compile_cache_stats, configure_compile_cache)
+configure_compile_cache(None)  # FAA_COMPILE_CACHE from the parent
+from fast_autoaugment_tpu.models import get_model
+from fast_autoaugment_tpu.ops.optim import build_optimizer
+from fast_autoaugment_tpu.train.steps import create_train_state, make_train_step
+width = int(os.environ.get("T_WIDTH", "1"))
+model = get_model({"type": "wresnet10_%d" % width}, 10)
+opt = build_optimizer({"type": "sgd", "decay": 2e-4, "clip": 5.0,
+                       "momentum": 0.9, "nesterov": True}, lambda s: 0.05)
+rng = jax.random.PRNGKey(0)
+sample = jnp.zeros((2, 8, 8, 3), jnp.float32)
+state = create_train_state(model, opt, rng, sample, use_ema=False)
+step = make_train_step(model, opt, num_classes=10, cutout_length=0,
+                       use_policy=False)
+host = np.random.default_rng(0)
+x = jnp.asarray(host.integers(0, 256, (4, 8, 8, 3), dtype=np.uint8))
+y = jnp.asarray(host.integers(0, 10, (4,), np.int32))
+pol = jnp.zeros((1, 1, 3), jnp.float32)
+t0 = time.perf_counter()
+state, m = step(state, x, y, pol, rng)
+jax.block_until_ready(state.params)
+print(json.dumps({"first_step_sec": time.perf_counter() - t0,
+                  "stats": compile_cache_stats()}))
+"""
+
+
+def _run_child(cache_dir, width=1):
+    env = dict(os.environ)
+    env["FAA_COMPILE_CACHE"] = str(cache_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["T_WIDTH"] = str(width)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_cache_key_stability_warm_second_process_cold_after_change(tmp_path):
+    """The tentpole acceptance shape: same config -> the second process
+    is WARM (hits, no misses, faster first step); a config change ->
+    cold again (misses)."""
+    cache = tmp_path / "cache"
+    cold = _run_child(cache)
+    assert cold["stats"]["misses"] > 0
+    assert cold["stats"]["labels"]["train_step"]["miss"] == 1
+
+    warm = _run_child(cache)
+    assert warm["stats"]["hits"] > 0
+    assert warm["stats"]["misses"] == 0
+    assert warm["stats"]["labels"]["train_step"]["hit"] == 1
+    # the whole point: the warm first step costs a fraction of cold
+    assert warm["first_step_sec"] < cold["first_step_sec"]
+
+    changed = _run_child(cache, width=2)  # different model width
+    assert changed["stats"]["misses"] > 0  # cold for the new program
+
+
+@pytest.mark.slow
+def test_cached_vs_fresh_executables_bitwise(tmp_path):
+    """Seeded equivalence across the cache boundary: a COLD process and
+    a WARM process (deserialized executables) produce bit-identical
+    training results — caching changes where executables come from,
+    never what they compute."""
+    conf = (
+        "model:\n  type: wresnet10_1\ndataset: synthetic\naug: default\n"
+        "cutout: 0\nbatch: 8\nepoch: 1\nlr: 0.05\n"
+        "lr_schedule:\n  type: cosine\n"
+        "optimizer:\n  type: sgd\n  decay: 0.0001\n  momentum: 0.9\n"
+        "  nesterov: true\n")
+    conf_yaml = tmp_path / "conf.yaml"
+    conf_yaml.write_text(conf)
+
+    def train(save, cache_dir):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("FAA_COMPILE_CACHE", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "fast_autoaugment_tpu.launch.train_cli",
+             "-c", str(conf_yaml), "--dataroot", str(tmp_path),
+             "--save", save, "--cv-ratio", "0.4",
+             "--evaluation-interval", "1",
+             "--compile-cache", str(cache_dir)],
+            env=env, capture_output=True, text=True, timeout=900)
+        assert r.returncode == 0, r.stderr[-2000:]
+        return r
+
+    import hashlib
+
+    def digest(path):
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+
+    ck_cache = tmp_path / "ck_cache"
+    train(str(tmp_path / "a.msgpack"), ck_cache)   # cold
+    r2 = train(str(tmp_path / "b.msgpack"), ck_cache)  # warm
+    assert re.search(r"compile cache: dir=\S+ hits=[1-9]", r2.stderr), \
+        r2.stderr[-2000:]
+    assert digest(tmp_path / "a.msgpack") == digest(tmp_path / "b.msgpack")
+
+
+@pytest.mark.slow
+def test_exit77_resume_reports_cache_hit(tmp_path):
+    """The resilience coupling end-to-end: a SIGTERMed CLI trainer
+    exits 77 (checkpointed), and the RESUMED process — sharing the
+    compile-cache dir — reports cache hits: the resume reached its
+    first step without re-paying the compile tax."""
+    conf_yaml = tmp_path / "conf.yaml"
+    conf_yaml.write_text(
+        "model:\n  type: wresnet10_1\ndataset: synthetic\naug: default\n"
+        "cutout: 0\nbatch: 8\nepoch: 2\nlr: 0.05\n"
+        "lr_schedule:\n  type: cosine\n"
+        "optimizer:\n  type: sgd\n  decay: 0.0001\n  momentum: 0.9\n"
+        "  nesterov: true\n")
+    cache = tmp_path / "cache"
+
+    def run(fault=None):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("FAA_FAULT", None)
+        env.pop("FAA_COMPILE_CACHE", None)
+        if fault:
+            env["FAA_FAULT"] = fault
+        return subprocess.run(
+            [sys.executable, "-m", "fast_autoaugment_tpu.launch.train_cli",
+             "-c", str(conf_yaml), "--dataroot", str(tmp_path),
+             "--save", str(tmp_path / "ck.msgpack"), "--cv-ratio", "0.4",
+             "--evaluation-interval", "1",
+             "--compile-cache", str(cache)],
+            env=env, capture_output=True, text=True, timeout=900)
+
+    r = run(fault="sigterm@step=2")
+    assert r.returncode == 77, (r.returncode, r.stderr[-2000:])
+
+    r2 = run()
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "resumed" in r2.stderr
+    m = re.search(r"compile cache: dir=\S+ hits=(\d+) misses=(\d+)",
+                  r2.stderr)
+    assert m, r2.stderr[-2000:]
+    assert int(m.group(1)) > 0, "resumed process reported no cache hits"

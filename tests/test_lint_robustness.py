@@ -154,6 +154,43 @@ def test_r4_robust_allow_suppression():
     assert not check_source(src, BLOCK_SCOPE)
 
 
+SERVE_SCOPE = "fast_autoaugment_tpu/serve/x.py"
+
+
+def test_r5_direct_jit_flagged_in_seam_dirs():
+    src = "import jax\nstep = jax.jit(body)\n"
+    for scope in (IN_SCOPE, TRAIN_SCOPE, SERVE_SCOPE):
+        assert "R5" in _rules(check_source(src, scope)), scope
+
+
+def test_r5_partial_and_decorator_forms_flagged():
+    # the historical steps.py idiom AND the decorator form both carry
+    # a jax.jit attribute reference — all uninstrumented compiles
+    src_partial = ("import functools, jax\n"
+                   "step = functools.partial(jax.jit, donate_argnums=(0,))(f)\n")
+    src_deco = "import jax\n@jax.jit\ndef f(x):\n    return x\n"
+    assert "R5" in _rules(check_source(src_partial, TRAIN_SCOPE))
+    assert "R5" in _rules(check_source(src_deco, TRAIN_SCOPE))
+
+
+def test_r5_out_of_scope_dirs_not_flagged():
+    src = "import jax\nstep = jax.jit(body)\n"
+    for scope in (OUT_SCOPE, "fast_autoaugment_tpu/ops/x.py",
+                  "fast_autoaugment_tpu/core/compilecache.py"):
+        assert "R5" not in _rules(check_source(src, scope)), scope
+
+
+def test_r5_seam_jit_is_clean():
+    src = ("from fast_autoaugment_tpu.core.compilecache import seam_jit\n"
+           "step = seam_jit(body, label='train_step', donate_argnums=(0,))\n")
+    assert not check_source(src, TRAIN_SCOPE)
+
+
+def test_r5_robust_allow_suppression():
+    src = "import jax\nstep = jax.jit(body)  # robust: allow — export path\n"
+    assert "R5" not in _rules(check_source(src, TRAIN_SCOPE))
+
+
 def test_repo_is_clean():
     """The live gate: the package must hold the discipline the
     resilience subsystem depends on (make lint-robust)."""

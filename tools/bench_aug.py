@@ -99,6 +99,8 @@ def main(argv=None):
     import json
 
     from bench import (
+        arm_compile_cache_from_env,
+        compile_cache_stamp,
         host_contention_stamp,
         refuse_or_flag_contention,
         watchdog_stamp,
@@ -106,6 +108,7 @@ def main(argv=None):
 
     contention = refuse_or_flag_contention(host_contention_stamp())
     print(f"contention: {json.dumps(contention)}")
+    arm_compile_cache_from_env()
 
     import jax
     import jax.numpy as jnp
@@ -188,6 +191,10 @@ def main(argv=None):
         "modes": modes,
         "policy_493": policy493,
         "full_stack": stack,
+        # unified compile stamp (same block as bench.py's JSON line) —
+        # the per-(mode, G) compile_sec entries above remain as raw
+        # timings; this is the comparable hit/miss record
+        "compile_cache": compile_cache_stamp(),
         "contention": contention,
         # auto-watchdog deadline the full train-aug dispatch wall
         # implies (fires=0: unmonitored) — hang-vs-straggler provenance

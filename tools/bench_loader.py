@@ -197,10 +197,16 @@ def main(argv=None):
     # weak 1); FAA_BENCH_REQUIRE_QUIET=1 refuses on a busy host
     import json
 
-    from bench import host_contention_stamp, refuse_or_flag_contention
+    from bench import (
+        arm_compile_cache_from_env,
+        compile_cache_stamp,
+        host_contention_stamp,
+        refuse_or_flag_contention,
+    )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
     print(f"contention: {json.dumps(contention)}")
+    arm_compile_cache_from_env()
 
     from fast_autoaugment_tpu.data import native_loader
 
@@ -244,6 +250,8 @@ def main(argv=None):
     # device-resident cache gather, one comparable JSON line
     gather = bench_gather()
     gather["contention"] = contention
+    # unified compile stamp (same block as bench.py's JSON line)
+    gather["compile_cache"] = compile_cache_stamp()
     print(json.dumps(gather))
 
     if args.report:

@@ -132,6 +132,8 @@ def main(argv=None):
 
     from bench import (  # dead-tunnel guard + load provenance (bench.py)
         _ensure_live_backend,
+        arm_compile_cache_from_env,
+        compile_cache_stamp,
         host_contention_stamp,
         refuse_or_flag_contention,
     )
@@ -140,6 +142,7 @@ def main(argv=None):
     _ensure_live_backend(
         reexec_argv=[sys.executable, os.path.abspath(__file__), *sys.argv[1:]]
     )
+    arm_compile_cache_from_env()
     cpu_fallback = bool(os.environ.get("FAA_BENCH_CPU_FALLBACK"))
     if cpu_fallback:
         # plumbing heartbeat only (mirrors bench.py's shrunk fallback):
@@ -165,6 +168,9 @@ def main(argv=None):
         if cpu_fallback:
             row["backend"] = "cpu-fallback"  # never masquerades as TPU
         row["contention"] = contention  # busy-host captures stay visible
+        # unified compile stamp (cumulative across the sweep): the
+        # comparable hit/miss record beside the raw compile_s timing
+        row["compile_cache"] = compile_cache_stamp()
         rows.append(row)
         print(json.dumps(row), flush=True)
 

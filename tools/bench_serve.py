@@ -1,0 +1,219 @@
+"""AOT policy-serving benchmark: p50/p99 latency + imgs/s at fixed
+offered QPS (``make bench-serve``).
+
+Drives the real serving pair — :class:`AotPolicyApplier` (AOT-compiled
+padded-shape executables) behind :class:`PolicyServer` (batch
+coalescing) — with an OPEN-LOOP arrival process at ``--qps``: requests
+are submitted on a fixed schedule regardless of completion (the
+heavy-traffic model; a closed loop would hide queueing collapse).  One
+JSON line reports:
+
+- ``latency_ms``: p50/p90/p99/max submit-to-scatter per request;
+- ``images_per_sec``: achieved serving throughput over the run;
+- ``aot_compile_sec`` per shape + the unified ``compile_cache`` block
+  (with ``FAA_COMPILE_CACHE`` set, a re-run deserializes the
+  executables — the warm-start story applied to serving);
+- the standard contention + shadow-watchdog stamps, plus a per-run
+  ``bitwise_match`` re-verification that exact-dispatch served outputs
+  equal direct ``apply_policy`` application.
+
+    python tools/bench_serve.py [--qps 200] [--seconds 5] [--image 32]
+        [--dispatch auto] [--shapes 1,8,32,128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synthetic_policy(num_sub: int, num_op: int):
+    """Deterministic multi-sub policy shaped like a search result (ops
+    cycle through the searchable table, probs/levels spread)."""
+    import numpy as np
+
+    rows = []
+    for i in range(num_sub):
+        rows.append([[(i * num_op + j) % 15, 0.4 + 0.1 * (i % 5),
+                      0.2 + 0.15 * ((i + j) % 5)]
+                     for j in range(num_op)])
+    return np.asarray(rows, np.float32)
+
+
+def verify_bitwise(applier, images, keys) -> bool:
+    """Exact-dispatch acceptance: served == direct apply_policy, bitwise
+    (grouped dispatch is checked against its own batch kernel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fast_autoaugment_tpu.ops.augment import (
+        apply_policy,
+        apply_policy_batch_grouped,
+    )
+
+    got = applier.apply(images, keys)
+    if applier.dispatch == "exact":
+        ref = np.stack([
+            np.asarray(apply_policy(
+                jnp.asarray(images[i], jnp.float32),
+                applier.policy, jnp.asarray(keys[i])))
+            for i in range(images.shape[0])])
+    else:
+        from fast_autoaugment_tpu.serve.policy_server import pick_shape
+
+        s = pick_shape(applier.shapes, images.shape[0])
+        padded = np.zeros((s,) + images.shape[1:], np.float32)
+        padded[:images.shape[0]] = images
+        ref = np.asarray(apply_policy_batch_grouped(
+            jnp.asarray(padded), applier.policy, jnp.asarray(keys),
+            groups=applier.groups))[:images.shape[0]]
+    return bool(np.array_equal(got, ref))
+
+
+def run_offered_load(server, images_pool, qps: float, seconds: float,
+                     imgs_per_request: int):
+    """Open-loop offered load: submit on schedule, collect latencies."""
+    import numpy as np
+
+    n_requests = max(1, int(qps * seconds))
+    interval = 1.0 / qps
+    pending = []
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        sched = t0 + i * interval
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        lo = (i * imgs_per_request) % (images_pool.shape[0]
+                                       - imgs_per_request + 1)
+        pending.append(server.submit(images_pool[lo:lo + imgs_per_request]))
+    for p in pending:
+        server.result(p, timeout=120.0)
+    t_end = max(p.t_done for p in pending)
+    lat_ms = np.asarray([p.latency() * 1e3 for p in pending])
+    total_imgs = sum(p.n for p in pending)
+    return {
+        "requests": n_requests,
+        "qps_offered": round(qps, 1),
+        "qps_achieved": round(n_requests / (t_end - t0), 1),
+        "images_per_sec": round(total_imgs / (t_end - t0), 1),
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat_ms, 50)), 3),
+            "p90": round(float(np.percentile(lat_ms, 90)), 3),
+            "p99": round(float(np.percentile(lat_ms, 99)), 3),
+            "max": round(float(lat_ms.max()), 3),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--policy", default=None,
+                   help="final_policy.json / archive name (default: a "
+                        "deterministic synthetic --num-sub policy)")
+    p.add_argument("--num-sub", type=int, default=5)
+    p.add_argument("--num-op", type=int, default=2)
+    p.add_argument("--image", type=int, default=32)
+    p.add_argument("--shapes", default="1,8,32,128")
+    p.add_argument("--dispatch", default="auto",
+                   choices=("auto", "exact", "grouped"))
+    p.add_argument("--groups", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--qps", type=float, default=200.0)
+    p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--imgs-per-request", type=int, default=1)
+    args = p.parse_args(argv)
+
+    from bench import (
+        host_contention_stamp,
+        refuse_or_flag_contention,
+        watchdog_stamp,
+    )
+
+    contention = refuse_or_flag_contention(host_contention_stamp())
+
+    import jax
+    import numpy as np
+
+    from fast_autoaugment_tpu.core.compilecache import (
+        compile_cache_stats,
+        configure_compile_cache,
+    )
+    from fast_autoaugment_tpu.serve.policy_server import (
+        AotPolicyApplier,
+        PolicyServer,
+    )
+
+    # honor an inherited FAA_COMPILE_CACHE: a second bench run then
+    # deserializes the AOT executables instead of re-lowering them
+    configure_compile_cache(None)
+
+    if args.policy:
+        from fast_autoaugment_tpu.serve.serve_cli import build_policy_tensor
+
+        policy = build_policy_tensor(args.policy)
+    else:
+        policy = synthetic_policy(args.num_sub, args.num_op)
+    shapes = tuple(int(s) for s in str(args.shapes).split(",") if s)
+
+    t0 = time.perf_counter()
+    applier = AotPolicyApplier(policy, image=args.image, shapes=shapes,
+                               dispatch=args.dispatch, groups=args.groups)
+    aot_secs = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    pool = rng.integers(
+        0, 256, (max(shapes) * 2, args.image, args.image, 3),
+        dtype=np.uint8).astype(np.float32)
+    # acceptance re-verification on this exact build: served outputs
+    # match the direct kernel bit-for-bit
+    n_check = min(3, max(shapes))
+    check_keys = (np.stack([np.asarray(jax.random.PRNGKey(i), np.uint32)
+                            for i in range(n_check)])
+                  if applier.dispatch == "exact"
+                  else np.asarray(jax.random.PRNGKey(7), np.uint32))
+    bitwise = verify_bitwise(applier, pool[:n_check], check_keys)
+
+    server = PolicyServer(applier, max_wait_ms=args.max_wait_ms).start()
+    # warm the dispatch path (first calls already AOT-compiled)
+    server.augment(pool[:1])
+    load = run_offered_load(server, pool, args.qps, args.seconds,
+                            args.imgs_per_request)
+    stats = server.stats()
+    server.stop()
+
+    out = {
+        "metric": "serve_policy_latency_ms",
+        "backend": jax.devices()[0].platform,
+        "policy": args.policy or f"synthetic_{args.num_sub}sub",
+        "num_sub": int(policy.shape[0]),
+        "image": args.image,
+        "dispatch": applier.dispatch,
+        "groups": applier.groups,
+        "shapes": list(applier.shapes),
+        "max_wait_ms": args.max_wait_ms,
+        "imgs_per_request": args.imgs_per_request,
+        **load,
+        "serving": stats,
+        "bitwise_match": bitwise,
+        "aot_compile_sec_total": round(aot_secs, 3),
+        "aot_compile": {str(s): r for s, r in applier.compile_log.items()},
+        # unified compile stamp (the block every bench JSON line carries)
+        "compile_cache": compile_cache_stats(),
+        "contention": contention,
+        "watchdog": watchdog_stamp(stats.get("mean_dispatch_ms", 0) and
+                                   [stats["mean_dispatch_ms"] / 1e3] or [],
+                                   label="serve_dispatch"),
+    }
+    print(json.dumps(out))
+    return 0 if bitwise else 4
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,6 +39,10 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
 
+    from bench import arm_compile_cache_from_env
+
+    arm_compile_cache_from_env()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -94,7 +98,7 @@ def main(argv=None) -> int:
     ms_per_call = steady / args.calls * 1e3
     # each call forwards batch x num_policy augmented images
     imgs_per_sec = args.batch * args.num_policy * args.calls / steady
-    from bench import host_contention_stamp, watchdog_stamp
+    from bench import compile_cache_stamp, host_contention_stamp, watchdog_stamp
 
     summary = {
         "backend": platform,
@@ -104,6 +108,9 @@ def main(argv=None) -> int:
         "image": args.image,
         "num_policy": args.num_policy,
         "compile_s": round(compile_s, 2),
+        # unified compile stamp (same block as bench.py's JSON line):
+        # cache hit/miss counts + per-label first-call seconds
+        "compile_cache": compile_cache_stamp(),
         "tta_ms_per_call": round(ms_per_call, 3),
         "tta_images_per_sec": round(imgs_per_sec, 1),
         "unix_time": time.time(),

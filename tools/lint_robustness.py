@@ -30,6 +30,15 @@ R4  **no untimed blocking** in ``core/``, ``launch/`` and ``search/``:
     monitor becomes the thing that hangs.  (Receiver tracking is
     constructor-based, so ``str.join`` / ``dict.get`` never match.)
 
+R5  **no direct ``jax.jit`` outside the compile seam** in ``train/``,
+    ``search/`` and ``serve/``: every jit entry point on those hot
+    paths must route through ``core/compilecache.py`` (``seam_jit`` /
+    ``aot_compile``) so its first-call compile is timed, classified
+    hit/miss against the persistent compilation cache, and stamped
+    into the run artifacts — an uninstrumented ``jax.jit`` silently
+    reintroduces the invisible 23-55 s compile tax the cache
+    subsystem exists to measure and kill.
+
 Suppress a finding (sparingly, with a reason nearby) by putting
 ``robust: allow`` in a comment on the offending line.
 
@@ -58,6 +67,12 @@ ARTIFACT_DIRS = ("core", "search", "train", "launch")
 # prefetch worker is excluded: its consumer-side get() is the
 # documented pipeline backpressure, not supervision.
 BLOCKING_DIRS = ("core", "launch", "search")
+
+# R5 scope: the layers whose jit entry points must stay
+# cache-instrumented (core/compilecache.py seam).  ops/ and models/
+# are excluded: their jits are library/bench conveniences, not run
+# hot paths, and the seam wraps them at the train/search call sites.
+JIT_SEAM_DIRS = ("train", "search", "serve")
 
 # constructor names whose instances carry blocking .join()/.get()
 _THREAD_CTORS = {"Thread", "Timer"}
@@ -184,9 +199,11 @@ def _has_timeout(call: ast.Call) -> bool:
 
 def check_source(src: str, relpath: str,
                  artifact_scope: bool | None = None,
-                 blocking_scope: bool | None = None) -> list[Finding]:
+                 blocking_scope: bool | None = None,
+                 jit_scope: bool | None = None) -> list[Finding]:
     """Lint one file's source.  `artifact_scope` forces R3 on/off,
-    `blocking_scope` forces R4 on/off (None = derive from `relpath`)."""
+    `blocking_scope` forces R4 on/off, `jit_scope` forces R5 on/off
+    (None = derive from `relpath`)."""
     findings: list[Finding] = []
     lines = src.splitlines()
 
@@ -208,6 +225,8 @@ def check_source(src: str, relpath: str,
         artifact_scope = _in_dirs(ARTIFACT_DIRS)
     if blocking_scope is None:
         blocking_scope = _in_dirs(BLOCKING_DIRS)
+    if jit_scope is None:
+        jit_scope = _in_dirs(JIT_SEAM_DIRS)
     blockers = _blocking_receivers(tree) if blocking_scope else set()
 
     # enclosing-function map for the R3 allowlist
@@ -270,6 +289,20 @@ def check_source(src: str, relpath: str,
                     f"untimed blocking .{f.attr}() on a Thread/Queue — "
                     "pass a timeout (the watchdog contract: supervision "
                     "code must never be able to hang forever)"))
+        if jit_scope and isinstance(node, ast.Attribute) \
+                and node.attr == "jit" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "jax" \
+                and not allowed(node.lineno):
+            # catches direct calls, functools.partial(jax.jit, ...) AND
+            # @jax.jit decorators: any reference to the attribute in
+            # seam scope is an uninstrumented compile path
+            findings.append(Finding(
+                relpath, node.lineno, "R5",
+                "direct jax.jit outside the compile seam — route "
+                "through core/compilecache.seam_jit / aot_compile so "
+                "the first-call compile is timed and classified "
+                "hit/miss against the persistent cache"))
     return findings
 
 
