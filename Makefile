@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-compile clean reproduce
+.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-overload bench-compile clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
@@ -51,6 +51,13 @@ bench-dispatch:
 # direct apply_policy bitwise (docs/BENCHMARKS.md "Compile cost & cache")
 bench-serve:
 	python tools/bench_serve.py
+
+# overload drill: offered QPS swept past calibrated capacity with
+# shedding on (bounded queue + deadlines + adaptive LIFO) vs off —
+# goodput, shed rate, deadline-miss rate and p99-of-admitted per arm
+# (docs/RESILIENCE.md "Serving under overload")
+bench-overload:
+	python tools/bench_serve.py --overload
 
 # cold/warm compile-tax bench: the same train-step workload in two
 # fresh processes sharing one FAA_COMPILE_CACHE dir — the warm process

@@ -21,7 +21,18 @@ module holds the pieces every layer shares:
 - **exit-code contract** — exit 77 == preempted-and-checkpointed.  77
   is outside the shell (126+) and signal (128+N) ranges and collides
   with nothing the CLIs emit today; ``launch/fleet.py`` treats it as
-  retry-eligible.
+  retry-eligible.  The SERVING side of the contract
+  (``serve/serve_cli.py``): SIGTERM triggers a graceful drain — stop
+  admitting, finish in-flight requests, exit **0** (a drained replica
+  is DONE, not failed); a replica that exits because its circuit
+  breaker latched open (``--breaker-exit``) uses **77** — "restart me",
+  exactly what the fleet supervisor's retry path does;
+- **circuit breaking** — :class:`CircuitBreaker` is the generic
+  closed/open/half-open state machine the policy server wraps around
+  its device dispatches: repeated failures OPEN the circuit (callers
+  fail fast with the typed :class:`CircuitOpenError` instead of piling
+  onto a wedged backend), a cooldown later ONE probe is admitted
+  half-open, and a probe success closes it again.
 
 See docs/RESILIENCE.md for the full failure taxonomy and the
 deterministic fault-injection harness (``utils/faultinject.py``) that
@@ -32,12 +43,15 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = [
     "PREEMPTED_EXIT_CODE",
     "CheckpointCorruptError",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "DispatchHungError",
     "PreemptedError",
     "install_signal_handlers",
@@ -88,6 +102,133 @@ class DispatchHungError(RuntimeError):
         self.label = label
         self.deadline_sec = deadline_sec
         self.waited_sec = waited_sec
+
+
+class CircuitOpenError(RuntimeError):
+    """The circuit breaker is OPEN: the backend has failed repeatedly
+    and callers fail fast instead of queueing onto it.  Carries
+    ``retry_after_s`` — the seconds until the breaker next admits a
+    half-open probe (the ``Retry-After`` the serving layer returns)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class CircuitBreaker:
+    """Closed / open / half-open failure containment.
+
+    - **closed**: calls flow; ``threshold`` CONSECUTIVE failures open
+      the circuit (one success resets the count);
+    - **open**: :meth:`allow` is False for ``cooldown_s`` — callers
+      fail fast with :class:`CircuitOpenError` instead of stacking onto
+      a backend that is erroring or hanging;
+    - **half-open**: after the cooldown exactly ONE probe call is
+      admitted; its success closes the circuit, its failure re-opens it
+      (a fresh cooldown, :attr:`fires` incremented again).
+
+    ``threshold <= 0`` disables the breaker entirely (:attr:`enabled`
+    False, :meth:`allow` always True) — the bit-for-bit default.
+    Thread-safe; the serving worker calls :meth:`allow` /
+    :meth:`record_success` / :meth:`record_failure` around each
+    dispatch while HTTP handler threads read :meth:`is_open` for
+    admission fast-fail and ``/readyz``.
+    """
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        self.fires = 0  # transitions into OPEN
+        self.consecutive_failures = 0
+        self._opened_at: float | None = None
+        self._probe_inflight = False
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def _cooldown_left(self) -> float:
+        if self._opened_at is None:
+            return 0.0
+        return max(0.0, self.cooldown_s - (time.monotonic() - self._opened_at))
+
+    def is_open(self) -> bool:
+        """Non-mutating admission check: True while the circuit is open
+        AND still cooling down (half-open probes are admitted by
+        :meth:`allow`, not here)."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            return self.state == "open" and self._cooldown_left() > 0.0
+
+    def retry_after_s(self) -> float:
+        """Seconds until the next half-open probe is admitted."""
+        with self._lock:
+            return self._cooldown_left()
+
+    def allow(self) -> bool:
+        """Whether the caller may dispatch NOW.  Consumes the single
+        half-open probe slot when the cooldown has elapsed; the probe's
+        record_success/record_failure releases it."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if self._cooldown_left() > 0.0:
+                    return False
+                self.state = "half_open"
+                logger.warning(
+                    "circuit breaker HALF-OPEN after %.1fs cooldown — "
+                    "admitting one probe", self.cooldown_s)
+            # half_open: exactly one probe in flight
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != "closed":
+                logger.warning("circuit breaker CLOSED (probe succeeded)")
+            self.state = "closed"
+            self.consecutive_failures = 0
+            self._opened_at = None
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.consecutive_failures += 1
+            self._probe_inflight = False
+            if self.state == "half_open" \
+                    or (self.state == "closed"
+                        and self.consecutive_failures >= self.threshold):
+                self.state = "open"
+                self.fires += 1
+                self._opened_at = time.monotonic()
+                logger.error(
+                    "circuit breaker OPEN (fire #%d, %d consecutive "
+                    "failures) — failing fast for %.1fs",
+                    self.fires, self.consecutive_failures, self.cooldown_s)
+
+    def snapshot(self) -> dict:
+        """Artifact-ready accounting (stamped into ``/stats`` and the
+        serving bench JSON)."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "state": self.state if self.enabled else "disabled",
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "fires": self.fires,
+                "consecutive_failures": self.consecutive_failures,
+                "retry_after_s": round(self._cooldown_left(), 3),
+            }
 
 
 # -- the preemption flag ----------------------------------------------

@@ -208,17 +208,27 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
                env_passthrough: tuple[str, ...], host_retries: int,
                retry_backoff: float, attempts_out: dict,
                elastic: bool = False, workqueue_dir: str | None = None,
-               heartbeat_timeout: float = 0.0):
+               heartbeat_timeout: float = 0.0, rank_args: bool = True):
     """Launch + babysit one host: relaunch on failure (exit 77 included)
     up to `host_retries` times with exponential backoff, SIGKILLing a
     heartbeat-stale (wedged) process first when configured; on final
     failure either tear the fleet down (default) or — ``elastic`` —
-    declare the host LOST and let the survivors finish its work."""
-    remote_cmd = command + [
-        "--coordinator", coordinator,
-        "--num-hosts", str(num_hosts),
-        "--host-id", str(host_id),
-    ]
+    declare the host LOST and let the survivors finish its work.
+
+    ``rank_args=False`` (fleet ``--no-rank-args``) launches the command
+    VERBATIM — replica supervision for commands with no multi-controller
+    rank surface (e.g. ``serve/serve_cli.py`` policy-serving replicas,
+    which would choke on ``--coordinator``); the replica still gets
+    ``FAA_HOST_ID``/``FAA_ATTEMPT`` in its environment so host beats
+    and attempt-gated fault specs stay addressable."""
+    if rank_args:
+        remote_cmd = command + [
+            "--coordinator", coordinator,
+            "--num-hosts", str(num_hosts),
+            "--host-id", str(host_id),
+        ]
+    else:
+        remote_cmd = list(command)
     host_tag = f"host{host_id}"
     base_envs = " ".join(
         f"{k}={shlex.quote(os.environ[k])}"
@@ -229,8 +239,10 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
         attempt += 1
         attempts_out[host] = attempt
         # FAA_ATTEMPT gates fault-injection specs to one attempt in the
-        # process chain (a relaunch re-reads the same FAA_FAULT)
-        envs = f"{base_envs} FAA_ATTEMPT={attempt}".strip()
+        # process chain (a relaunch re-reads the same FAA_FAULT);
+        # FAA_HOST_ID addresses rank-free replicas (serve host beats)
+        envs = (f"{base_envs} FAA_ATTEMPT={attempt} "
+                f"FAA_HOST_ID={host_id}").strip()
         # NO setsid: the remote command must keep the ssh pty as its
         # controlling terminal so pty teardown HUPs the whole foreground
         # group — a setsid-detached tree would never see the hangup and
@@ -319,7 +331,8 @@ def launch_fleet(hosts: list[str], command: list[str],
                  retry_backoff: float = 1.0,
                  elastic: bool = False,
                  workqueue_dir: str | None = None,
-                 heartbeat_timeout: float = 0.0) -> int:
+                 heartbeat_timeout: float = 0.0,
+                 rank_args: bool = True) -> int:
     """Run `command` on every host over SSH; returns the first genuine
     failure's exit code (0 when every host eventually succeeds).
 
@@ -336,7 +349,17 @@ def launch_fleet(hosts: list[str], command: list[str],
     units).  `workqueue_dir` + `heartbeat_timeout` arm the wedge
     detector: an alive process whose host beat under
     ``<dir>/hosts/host<id>.json`` is older than the timeout is
-    SIGKILLed and relaunched through the normal retry path."""
+    SIGKILLed and relaunched through the normal retry path.
+
+    `rank_args=False` runs the command verbatim (no
+    ``--coordinator/--num-hosts/--host-id`` suffix) — REPLICA
+    supervision for rank-free services; each replica still gets
+    ``FAA_HOST_ID``/``FAA_ATTEMPT`` exported.  The serving use:
+    ``--no-rank-args -- python -m fast_autoaugment_tpu.serve.serve_cli
+    --policy … --breaker-exit --heartbeat-dir Q`` gives every serving
+    replica breaker-open restart (exit 77 is retry-eligible) and
+    wedge-detection for free (docs/RESILIENCE.md "Serving under
+    overload")."""
     fleet = _Fleet()
     coordinator = coordinator or f"{hosts[0]}:8476"
     host_retries = max(0, int(host_retries))
@@ -357,7 +380,7 @@ def launch_fleet(hosts: list[str], command: list[str],
             target=_supervise,
             args=(fleet, host_id, host, command, coordinator, len(hosts),
                   env_passthrough, host_retries, retry_backoff, attempts,
-                  elastic, workqueue_dir, heartbeat_timeout),
+                  elastic, workqueue_dir, heartbeat_timeout, rank_args),
             daemon=True,
         )
         t.start()
@@ -420,6 +443,13 @@ def main(argv=None):
                         "--workqueue, reclaim its work units).  Fleet "
                         "exit 0 when >= 1 host succeeds "
                         "(docs/RESILIENCE.md 'Self-healing fleet')")
+    p.add_argument("--no-rank-args", action="store_true",
+                   help="launch the command VERBATIM (no --coordinator/"
+                        "--num-hosts/--host-id suffix): replica "
+                        "supervision for rank-free services like the "
+                        "serving CLI — retries, --elastic and "
+                        "--heartbeat-timeout all apply; each replica "
+                        "gets FAA_HOST_ID/FAA_ATTEMPT exported")
     p.add_argument("--workqueue", default=None, metavar="DIR",
                    help="the workers' shared lease-queue dir (pass the "
                         "same DIR to the worker CLI); arms the "
@@ -456,7 +486,8 @@ def main(argv=None):
                         retry_backoff=args.retry_backoff,
                         elastic=args.elastic,
                         workqueue_dir=args.workqueue,
-                        heartbeat_timeout=args.heartbeat_timeout)
+                        heartbeat_timeout=args.heartbeat_timeout,
+                        rank_args=not args.no_rank_args)
     sys.exit(code)
 
 

@@ -34,20 +34,34 @@ scatter back to each request in FIFO order.  That is the
 latency/throughput knob heavy traffic needs: big offered load rides the
 large shapes at full device efficiency, a lone request still completes
 within ``max_wait_ms`` + one dispatch.
+
+The server is OVERLOAD-SAFE (docs/RESILIENCE.md "Serving under
+overload"): admission is non-blocking and fail-fast (bounded queue,
+typed :class:`ServerOverloadedError`), per-request deadlines shed
+already-dead work before it reaches the device, depth/age watermarks
+flip the drain order to adaptive-LIFO under sustained saturation, a
+circuit breaker (:class:`~fast_autoaugment_tpu.core.resilience.
+CircuitBreaker`) contains a failing/hanging backend, and
+:meth:`PolicyServer.swap_applier` hot-reloads a new policy with zero
+dropped requests and no half-policy batch.  Every knob defaults off =
+the clean-weather PR-7 stream.
 """
 
 from __future__ import annotations
 
-import queue
+import collections
 import threading
 import time
 from typing import Sequence
 
 import numpy as np
 
+from fast_autoaugment_tpu.core.resilience import CircuitBreaker, CircuitOpenError
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = ["AotPolicyApplier", "PolicyServer", "ServeError",
+           "ServerOverloadedError", "ServerStoppedError",
+           "DeadlineExpiredError", "CircuitOpenError",
            "DEFAULT_SHAPES", "pick_shape"]
 
 logger = get_logger("faa_tpu.serve")
@@ -283,14 +297,39 @@ def deserialize_apply(blob: bytes):
     return lambda images, keys: exported.call(images, keys)
 
 
+class ServerOverloadedError(ServeError):
+    """Admission rejected: the bounded request queue is full.  The
+    caller should back off ``retry_after_s`` and retry (HTTP 429 +
+    ``Retry-After`` in ``serve_cli``).  Raised IMMEDIATELY — admission
+    never blocks the caller on a full queue."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.05):
+        super().__init__(msg)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class ServerStoppedError(ServeError):
+    """Submitted to a stopped or draining server: no new work is
+    admitted (the graceful-drain contract — in-flight requests still
+    complete)."""
+
+
+class DeadlineExpiredError(ServeError):
+    """The request's deadline passed before its dispatch: it was SHED
+    (never reached the device — dead work must not burn a dispatch) or
+    completed hopelessly late."""
+
+
 class _Pending:
     """One in-flight request: `n` images, completion event, result or
-    error, submit/done walls for the latency record."""
+    error, submit/done walls for the latency record, and an optional
+    absolute deadline (``time.perf_counter()`` seconds)."""
 
     __slots__ = ("images", "keys", "event", "result", "error",
-                 "t_submit", "t_done")
+                 "t_submit", "t_done", "deadline")
 
-    def __init__(self, images: np.ndarray, keys: np.ndarray | None):
+    def __init__(self, images: np.ndarray, keys: np.ndarray | None,
+                 deadline: float | None = None):
         self.images = images
         self.keys = keys
         self.event = threading.Event()
@@ -298,6 +337,7 @@ class _Pending:
         self.error: BaseException | None = None
         self.t_submit = time.perf_counter()
         self.t_done = 0.0
+        self.deadline = deadline
 
     @property
     def n(self) -> int:
@@ -306,9 +346,88 @@ class _Pending:
     def latency(self) -> float:
         return self.t_done - self.t_submit
 
+    def expired(self, now: float | None = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) >= self.deadline
+
+
+class _RequestQueue:
+    """Bounded request buffer with NON-BLOCKING admission and
+    watermark-selected drain order.
+
+    ``offer`` never blocks: it returns False on a full (or closed)
+    queue and the caller sheds the request with a typed error — the
+    blocking-admission bug class (an HTTP handler thread parked on
+    ``Queue.put(timeout=30)``) is impossible by construction.
+
+    ``take`` drains FIFO in clean weather.  Under sustained overload —
+    depth at/above ``lifo_depth`` or the OLDEST queued request older
+    than ``lifo_age_ms`` — it switches to adaptive-LIFO (newest-first):
+    when the queue is deep, the oldest requests are the ones whose
+    clients have most likely already given up, so serving the newest
+    first maximizes goodput while the shed pass retires the expired
+    tail.  Both watermarks default to 0 = off (pure FIFO, the
+    bit-for-bit PR-7 drain order).
+    """
+
+    def __init__(self, depth: int, *, lifo_depth: int = 0,
+                 lifo_age_ms: float = 0.0):
+        self.depth = int(depth)
+        self.lifo_depth = int(lifo_depth)
+        self.lifo_age_ms = float(lifo_age_ms)
+        self._items: collections.deque[_Pending] = collections.deque()
+        self._cond = threading.Condition()
+        self.lifo_takes = 0  # takes served newest-first (stats)
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return len(self) == 0
+
+    def offer(self, item: _Pending) -> bool:
+        """Admit `item` or return False NOW (full) — never blocks."""
+        with self._cond:
+            if len(self._items) >= self.depth:
+                return False
+            self._items.append(item)
+            self._cond.notify()
+            return True
+
+    def _lifo_active(self) -> bool:
+        if self.lifo_depth > 0 and len(self._items) >= self.lifo_depth:
+            return True
+        if self.lifo_age_ms > 0 and self._items:
+            oldest_age = time.perf_counter() - self._items[0].t_submit
+            if oldest_age * 1e3 >= self.lifo_age_ms:
+                return True
+        return False
+
+    def take(self, timeout: float) -> _Pending | None:
+        """Pop one request (None on timeout).  Drain order is FIFO, or
+        newest-first while a watermark holds."""
+        with self._cond:
+            if not self._items:
+                self._cond.wait(timeout=timeout)
+            if not self._items:
+                return None
+            if self._lifo_active():
+                self.lifo_takes += 1
+                return self._items.pop()
+            return self._items.popleft()
+
+    def drain(self) -> list[_Pending]:
+        with self._cond:
+            items = list(self._items)
+            self._items.clear()
+            return items
+
 
 class PolicyServer:
-    """Batch-coalescing request front for an :class:`AotPolicyApplier`.
+    """Batch-coalescing, overload-safe request front for an
+    :class:`AotPolicyApplier`.
 
     The worker collects requests until ``max_batch`` images are queued
     or ``max_wait_ms`` has passed since the FIRST queued request, pads
@@ -316,11 +435,46 @@ class PolicyServer:
     rows back in FIFO order.  A request that would overflow the batch
     is carried to the next dispatch intact (requests are never split,
     so per-request key streams stay contiguous).
+
+    Overload behavior (all knobs default OFF = the PR-7 clean-weather
+    stream, except that admission now FAILS FAST on a full queue
+    instead of blocking the caller — the blocking-admission bug fix):
+
+    - **admission**: ``submit`` on a full queue raises the typed
+      :class:`ServerOverloadedError` immediately; after :meth:`stop` /
+      :meth:`begin_drain` it raises :class:`ServerStoppedError`; while
+      the breaker is open it raises
+      :class:`~fast_autoaugment_tpu.core.resilience.CircuitOpenError`;
+    - **deadlines**: ``submit(..., deadline_ms=D)`` stamps an absolute
+      deadline; the collector SHEDS already-expired requests before
+      padding/dispatch (typed :class:`DeadlineExpiredError`, counted
+      ``expired``) so dead work never reaches the device;
+    - **drain order**: ``lifo_depth`` / ``lifo_age_ms`` watermarks
+      switch the queue to adaptive-LIFO under sustained overload
+      (:class:`_RequestQueue`);
+    - **failure containment**: ``breaker_threshold`` consecutive
+      dispatch failures (errors, or walls above ``dispatch_timeout_s``)
+      open a :class:`~fast_autoaugment_tpu.core.resilience.
+      CircuitBreaker` — queued requests fail fast with typed errors
+      until a half-open probe succeeds;
+    - **hot reload**: :meth:`swap_applier` atomically swaps in a new
+      (pre-warmed) applier between dispatches — no dropped requests,
+      no half-policy batch (each dispatch binds ONE applier).
+
+    The ``FAA_FAULT`` verbs ``serve_error@dispatch=N`` and
+    ``serve_slow@dispatch=N,factor=F`` are consulted at the dispatch
+    seam (``utils/faultinject.py``) so every path above is driven
+    deterministically in tests.
     """
 
     def __init__(self, applier: AotPolicyApplier, *,
                  max_batch: int | None = None, max_wait_ms: float = 5.0,
-                 queue_depth: int = 4096, seed: int = 0):
+                 queue_depth: int = 4096, seed: int = 0,
+                 default_deadline_ms: float | None = None,
+                 lifo_depth: int = 0, lifo_age_ms: float = 0.0,
+                 breaker_threshold: int = 0,
+                 breaker_cooldown_s: float = 5.0,
+                 dispatch_timeout_s: float = 0.0):
         self.applier = applier
         self.max_batch = int(max_batch or applier.max_batch)
         if self.max_batch > applier.max_batch:
@@ -328,19 +482,42 @@ class PolicyServer:
                 f"max_batch {self.max_batch} exceeds the largest AOT "
                 f"shape {applier.max_batch}")
         self.max_wait_ms = float(max_wait_ms)
-        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self.queue_depth = int(queue_depth)
+        self._q = _RequestQueue(self.queue_depth, lifo_depth=lifo_depth,
+                                lifo_age_ms=lifo_age_ms)
         self._carry: _Pending | None = None
         self._stop = threading.Event()
+        # admission gate: set by stop() AND begin_drain() — a submit
+        # after either gets the typed error instead of racing the drain
+        self._closed = threading.Event()
         self._worker: threading.Thread | None = None
         self._seed = int(seed)
         self._auto_key_counter = 0
         self._lock = threading.Lock()
+        self.default_deadline_ms = (None if default_deadline_ms is None
+                                    else float(default_deadline_ms))
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
+        self.breaker = CircuitBreaker(threshold=breaker_threshold,
+                                      cooldown_s=breaker_cooldown_s)
+        #: grace past a request's deadline that result() still waits —
+        #: covers the shed pass delivering the typed error
+        self.deadline_grace_s = 1.0
         # serving accounting for the bench/stats endpoints
         self.dispatches = 0
         self.requests = 0
         self.images_served = 0
         self.batch_sizes: list[int] = []
         self.dispatch_walls: list[float] = []
+        # robustness accounting (admission / shed / breaker / reload)
+        self.admitted = 0
+        self.shed_overload = 0
+        self.shed_breaker = 0
+        self.shed_stopped = 0
+        self.expired = 0
+        self.deadline_misses = 0
+        self.reloads = 0
+        self._dispatch_attempts = 0  # incl. fast-fails + injected errors
+        self._wall_ema: float | None = None
 
     # ------------------------------------------------------- lifecycle
 
@@ -348,17 +525,47 @@ class PolicyServer:
         if self._worker is not None and self._worker.is_alive():
             return self
         self._stop.clear()
+        self._closed.clear()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="policy-server")
         self._worker.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
+        self._closed.set()
         self._stop.set()
         if self._worker is not None:
-            # bounded join (lint R4): a wedged dispatch must not hang
-            # shutdown — the worker is a daemon either way
+            # bounded join (lint R4/R6): a wedged dispatch must not
+            # hang shutdown — the worker is a daemon either way
             self._worker.join(timeout=timeout)
+
+    def begin_drain(self) -> None:
+        """Stop admitting (submit raises :class:`ServerStoppedError`);
+        queued and in-flight requests still complete.  The worker exits
+        once the queue is empty."""
+        self._closed.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful drain: stop admitting, finish everything queued,
+        stop the worker.  Returns True when fully drained within
+        `timeout` (False = a dispatch is stuck; the worker is a daemon
+        and the caller should exit anyway)."""
+        self.begin_drain()
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        if self._worker is not None:
+            while self._worker.is_alive():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._worker.join(timeout=min(0.2, left))
+        drained = self._q.empty() and self._carry is None \
+            and (self._worker is None or not self._worker.is_alive())
+        self._stop.set()
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        return self._closed.is_set()
 
     # --------------------------------------------------------- clients
 
@@ -378,12 +585,20 @@ class PolicyServer:
             jax.vmap(lambda i: jax.random.fold_in(root, i))(idx), np.uint32)
 
     def submit(self, images: np.ndarray,
-               keys: np.ndarray | None = None) -> _Pending:
+               keys: np.ndarray | None = None, *,
+               deadline_ms: float | None = None) -> _Pending:
         """Queue ``images [n, H, W, C]`` (or one ``[H, W, C]`` image).
 
         `keys` (``[n, 2]`` uint32) pins the per-image PRNG streams —
         the reproducible-serving contract; None lets the server derive
-        them.  Returns a pending handle for :meth:`result`."""
+        them.  `deadline_ms` (relative; default the server's
+        ``default_deadline_ms``) stamps the deadline after which the
+        request is shed instead of dispatched.  Returns a pending
+        handle for :meth:`result`.  NEVER blocks: a full queue raises
+        :class:`ServerOverloadedError`, a stopped/draining server
+        :class:`ServerStoppedError`, an open breaker
+        :class:`~fast_autoaugment_tpu.core.resilience.CircuitOpenError`
+        — all immediately."""
         images = np.asarray(images)
         if images.ndim == 3:
             images = images[None]
@@ -394,27 +609,99 @@ class PolicyServer:
             raise ValueError(
                 f"request of {n} images exceeds max_batch "
                 f"{self.max_batch} — split client-side")
+        if self._closed.is_set():
+            with self._lock:
+                self.shed_stopped += 1
+            raise ServerStoppedError(
+                "server is stopped/draining — not admitting requests")
+        if self.breaker.is_open():
+            with self._lock:
+                self.shed_breaker += 1
+            raise CircuitOpenError(
+                "circuit breaker open — backend failing, not admitting "
+                "requests", retry_after_s=self.breaker.retry_after_s())
         if keys is None and self.applier.dispatch == "exact":
             keys = self._auto_keys(n)
         elif keys is not None:
             keys = np.asarray(keys, np.uint32).reshape(n, 2)
-        pending = _Pending(images, keys)
-        self._q.put(pending, timeout=30.0)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (None if deadline_ms is None
+                    else time.perf_counter() + float(deadline_ms) / 1e3)
+        pending = _Pending(images, keys, deadline)
+        if not self._q.offer(pending):
+            with self._lock:
+                self.shed_overload += 1
+            raise ServerOverloadedError(
+                f"queue full ({self.queue_depth} requests) — shedding",
+                retry_after_s=max(0.05, self.max_wait_ms / 1e3))
+        with self._lock:
+            self.admitted += 1
         return pending
 
     def result(self, pending: _Pending, timeout: float = 60.0) -> np.ndarray:
-        """Block for a submitted request's augmented images."""
+        """Block for a submitted request's augmented images.  A request
+        with a deadline never waits (much) past it: the effective
+        timeout is bounded by the deadline plus a small grace for the
+        shed pass to deliver the typed error."""
+        if pending.deadline is not None:
+            left = pending.deadline - time.perf_counter()
+            timeout = min(timeout, max(0.0, left) + self.deadline_grace_s)
         if not pending.event.wait(timeout=timeout):
             raise TimeoutError(
-                f"no result within {timeout}s ({pending.n} images)")
+                f"no result within {timeout:.3f}s ({pending.n} images)")
         if pending.error is not None:
+            if isinstance(pending.error, (ServeError, CircuitOpenError)):
+                raise pending.error
             raise ServeError(str(pending.error)) from pending.error
         return pending.result
 
     def augment(self, images: np.ndarray, keys: np.ndarray | None = None,
-                timeout: float = 60.0) -> np.ndarray:
+                timeout: float = 60.0,
+                deadline_ms: float | None = None) -> np.ndarray:
         """Submit + wait — the one-call client path."""
-        return self.result(self.submit(images, keys), timeout=timeout)
+        return self.result(self.submit(images, keys, deadline_ms=deadline_ms),
+                           timeout=timeout)
+
+    # ------------------------------------------------------ hot reload
+
+    def swap_applier(self, new_applier: AotPolicyApplier) -> dict:
+        """Atomically swap the serving applier (hot policy reload).
+
+        The caller builds (and thereby AOT-warms) `new_applier` OFF TO
+        THE SIDE; this method only flips the reference.  The worker
+        binds ``self.applier`` ONCE per dispatch, so every coalesced
+        batch is served by exactly one policy — zero half-policy
+        batches — and queued requests are never dropped (they simply
+        dispatch under whichever applier is live at their turn).  Old
+        executables retire once their in-flight dispatch completes
+        (nothing else holds a reference).
+
+        The new applier must serve the same request contract: equal
+        image/channels, the SAME dispatch mode (a request's key shape
+        depends on it), and a ``max_batch`` covering the server's."""
+        old = self.applier
+        if (new_applier.image, new_applier.channels) != (old.image,
+                                                         old.channels):
+            raise ValueError(
+                f"reload changes served geometry "
+                f"{(old.image, old.channels)} -> "
+                f"{(new_applier.image, new_applier.channels)}")
+        if new_applier.dispatch != old.dispatch:
+            raise ValueError(
+                f"reload changes dispatch mode {old.dispatch!r} -> "
+                f"{new_applier.dispatch!r} — queued keys would not fit")
+        if new_applier.max_batch < self.max_batch:
+            raise ValueError(
+                f"new applier's largest AOT shape {new_applier.max_batch} "
+                f"is below the server's max_batch {self.max_batch}")
+        with self._lock:
+            self.applier = new_applier
+            self.reloads += 1
+            n = self.reloads
+        logger.info("hot reload #%d: applier swapped (%d sub-policies)",
+                    n, new_applier.num_sub)
+        return {"reloads": n, "num_sub": new_applier.num_sub}
 
     # ---------------------------------------------------------- worker
 
@@ -422,26 +709,44 @@ class PolicyServer:
         if self._carry is not None:
             first, self._carry = self._carry, None
             return first
-        try:
-            # bounded get: the stop flag is polled between waits
-            return self._q.get(timeout=0.05)
-        except queue.Empty:
-            return None
+        # bounded take: the stop/drain flags are polled between waits
+        return self._q.take(timeout=0.05)
+
+    def _shed(self, p: _Pending, now: float) -> None:
+        """Retire one expired request BEFORE padding/dispatch — dead
+        work never reaches the device."""
+        p.error = DeadlineExpiredError(
+            f"deadline passed {now - p.deadline:.3f}s ago while queued "
+            f"({p.n} images) — request shed before dispatch")
+        p.t_done = now
+        p.event.set()
+        with self._lock:
+            self.expired += 1
 
     def _collect(self, first: _Pending) -> list[_Pending]:
         """Coalesce: up to ``max_batch`` images or ``max_wait_ms`` after
-        the FIRST request of the batch arrived."""
-        batch = [first]
-        count = first.n
+        the FIRST request of the batch arrived.  Expired requests are
+        shed as they are encountered and never join the batch."""
+        batch: list[_Pending] = []
+        count = 0
+        now = time.perf_counter()
+        if first.expired(now):
+            self._shed(first, now)
+        else:
+            batch.append(first)
+            count = first.n
         deadline = time.perf_counter() + self.max_wait_ms / 1e3
         while count < self.max_batch:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
                 break
-            try:
-                nxt = self._q.get(timeout=remaining)
-            except queue.Empty:
+            nxt = self._q.take(timeout=remaining)
+            if nxt is None:
                 break
+            now = time.perf_counter()
+            if nxt.expired(now):
+                self._shed(nxt, now)
+                continue
             if count + nxt.n > self.max_batch:
                 # never split a request: carry it whole to the next
                 # dispatch (FIFO preserved — the carry is taken first)
@@ -451,31 +756,78 @@ class PolicyServer:
             count += nxt.n
         return batch
 
+    def _fail_batch(self, batch: list[_Pending], err: BaseException) -> None:
+        done = time.perf_counter()
+        for p in batch:
+            p.error = err
+            p.t_done = done
+            p.event.set()
+
+    def _injected_fault(self) -> tuple[str, float] | None:
+        """Consult the FAA_FAULT serve verbs with the 1-based dispatch
+        attempt counter (fast None path with FAA_FAULT unset)."""
+        from fast_autoaugment_tpu.utils.faultinject import active_plan
+
+        plan = active_plan()
+        if plan is None:
+            return None
+        return plan.serve_fault(self._dispatch_attempts)
+
     def _dispatch(self, batch: list[_Pending]) -> None:
+        applier = self.applier  # ONE applier per dispatch (reload seam)
+        self._dispatch_attempts += 1
+        if self.breaker.enabled and not self.breaker.allow():
+            # open circuit: fail the whole batch fast — no device work
+            err = CircuitOpenError(
+                "circuit breaker open — dispatch failed fast",
+                retry_after_s=self.breaker.retry_after_s())
+            with self._lock:
+                self.shed_breaker += len(batch)
+            self._fail_batch(batch, err)
+            return
         images = np.concatenate([p.images for p in batch])
-        if self.applier.dispatch == "exact":
+        if applier.dispatch == "exact":
             keys = np.concatenate([p.keys for p in batch])
         else:
             # one program key per dispatch, derived server-side
             keys = self._auto_keys(1)[0]
+        fault = self._injected_fault()
         t0 = time.perf_counter()
         try:
-            out = self.applier.apply(images, keys)
+            if fault is not None and fault[0] == "error":
+                raise ServeError(
+                    f"faultinject: serve_error at dispatch "
+                    f"{self._dispatch_attempts}")
+            if fault is not None and fault[0] == "slow":
+                base = self._wall_ema if self._wall_ema else 1.0
+                time.sleep(min(fault[1] * base, 300.0))
+            out = applier.apply(images, keys)
         except Exception as e:  # noqa: BLE001 — delivered to every caller
             logger.error("serving dispatch failed (%d images): %s",
                          images.shape[0], e)
-            for p in batch:
-                p.error = e
-                p.t_done = time.perf_counter()
-                p.event.set()
+            self.breaker.record_failure()
+            self._fail_batch(batch, e)
             return
         wall = time.perf_counter() - t0
+        if self.dispatch_timeout_s > 0 and wall > self.dispatch_timeout_s:
+            # a straggler past the dispatch budget counts toward the
+            # breaker even though its results are delivered — repeated
+            # near-hangs must open the circuit before a real one wedges
+            logger.warning(
+                "dispatch took %.3fs > dispatch_timeout %.3fs — counted "
+                "as a breaker failure", wall, self.dispatch_timeout_s)
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
         lo = 0
         done = time.perf_counter()
+        misses = 0
         for p in batch:
             p.result = out[lo:lo + p.n]
             lo += p.n
             p.t_done = done
+            if p.deadline is not None and done > p.deadline:
+                misses += 1
             p.event.set()
         with self._lock:
             self.dispatches += 1
@@ -483,23 +835,29 @@ class PolicyServer:
             self.images_served += images.shape[0]
             self.batch_sizes.append(images.shape[0])
             self.dispatch_walls.append(wall)
+            self.deadline_misses += misses
+        self._wall_ema = (wall if self._wall_ema is None
+                          else 0.2 * wall + 0.8 * self._wall_ema)
 
     def _run(self) -> None:
         while not self._stop.is_set():
             first = self._take_first()
             if first is None:
+                if self._closed.is_set():
+                    break  # draining and the queue ran dry: done
                 continue
-            self._dispatch(self._collect(first))
+            batch = self._collect(first)
+            if batch:
+                self._dispatch(batch)
         # drain on stop: in-flight clients must not hang forever
         leftovers = [self._carry] if self._carry is not None else []
         self._carry = None
-        while True:
-            try:
-                leftovers.append(self._q.get(timeout=0.01))
-            except queue.Empty:
-                break
+        leftovers.extend(self._q.drain())
+        if leftovers:
+            with self._lock:
+                self.shed_stopped += len(leftovers)
         for p in leftovers:
-            p.error = ServeError("server stopped")
+            p.error = ServerStoppedError("server stopped")
             p.t_done = time.perf_counter()
             p.event.set()
 
@@ -517,6 +875,25 @@ class PolicyServer:
                 "max_wait_ms": self.max_wait_ms,
                 "dispatch": self.applier.dispatch,
                 "shapes": list(self.applier.shapes),
+                # robustness counters (admission / shed / breaker /
+                # reload) — stamped into /stats and the bench JSON
+                "admission": {
+                    "queue_depth": self.queue_depth,
+                    "queued": len(self._q),
+                    "admitted": self.admitted,
+                    "shed_overload": self.shed_overload,
+                    "shed_breaker": self.shed_breaker,
+                    "shed_stopped": self.shed_stopped,
+                    "expired": self.expired,
+                    "deadline_misses": self.deadline_misses,
+                    "lifo_takes": self._q.lifo_takes,
+                    "lifo_depth": self._q.lifo_depth,
+                    "lifo_age_ms": self._q.lifo_age_ms,
+                    "default_deadline_ms": self.default_deadline_ms,
+                },
+                "breaker": self.breaker.snapshot(),
+                "reloads": self.reloads,
+                "draining": self._closed.is_set(),
             }
         if sizes:
             out["mean_batch"] = round(float(np.mean(sizes)), 2)

@@ -12,14 +12,39 @@ a restarted server deserializes them in seconds), and serves:
 - ``POST /augment`` — body is an ``.npz`` with ``images``
   (``[n, H, W, C]`` uint8 or float32) and optionally ``seeds``
   (``[n]`` int, pinning per-image PRNG streams for reproducible
-  serving).  Response is an ``.npz`` with the augmented ``images``
-  (uint8).  Requests from concurrent clients COALESCE into shared
-  device dispatches (:class:`~fast_autoaugment_tpu.serve.PolicyServer`).
-- ``GET /stats`` — serving accounting + the ``compile_cache`` stamp.
-- ``GET /healthz`` — liveness.
+  serving).  An ``X-FAA-Deadline-Ms`` header stamps the request's
+  deadline: expired requests are SHED before dispatch instead of
+  burning device work.  Response is an ``.npz`` with the augmented
+  ``images`` (uint8).  Requests from concurrent clients COALESCE into
+  shared device dispatches
+  (:class:`~fast_autoaugment_tpu.serve.PolicyServer`).  Errors are
+  structured JSON — 400 (malformed), 413 (body too large), 429 + a
+  ``Retry-After`` header (queue full — back off), 503 (breaker open /
+  draining / deadline missed), never a bare traceback.
+- ``POST /reload`` — hot policy reload: body is optional JSON
+  ``{"policy": PATH}`` (default: the ``--policy`` the server started
+  with, re-read).  The new policy AOT-warms off to the side and swaps
+  in atomically — zero dropped requests, no half-policy batch.  SIGHUP
+  triggers the same reload.
+- ``GET /stats`` — serving accounting (admission/shed/breaker/reload
+  counters included) + the ``compile_cache`` stamp.
+- ``GET /healthz`` — LIVENESS: 200 while the process runs.
+- ``GET /readyz`` — READINESS: 200 only while the server is admitting
+  and the circuit breaker is closed; 503 while draining or broken (a
+  load balancer stops routing here while ``/healthz`` still says the
+  replica is alive).
 
-``tools/bench_serve.py`` (``make bench-serve``) measures the in-process
-latency/throughput envelope of the same applier/server pair.
+SIGTERM triggers a graceful drain — stop admitting, finish in-flight
+requests, exit **0** (the serving arm of the exit-code contract,
+``core/resilience.py``).  ``--breaker-exit`` maps a latched-open
+breaker to exit **77** ("restart me"): under
+``launch/fleet.py --no-rank-args`` supervision the replica is
+relaunched and returns to ready (docs/RESILIENCE.md "Serving under
+overload").
+
+``tools/bench_serve.py`` (``make bench-serve`` / ``make
+bench-overload``) measures the in-process latency/throughput and
+overload envelopes of the same applier/server pair.
 """
 
 from __future__ import annotations
@@ -27,16 +52,29 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import os
 import signal
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from fast_autoaugment_tpu.core.resilience import (
+    PREEMPTED_EXIT_CODE,
+    CircuitOpenError,
+)
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 logger = get_logger("faa_tpu.serve_cli")
+
+#: default POST body bound: 64 MiB holds a 128-image float32 batch at
+#: 224px with generous npz overhead; bigger bodies are a client bug or
+#: an attack, either way 413
+DEFAULT_MAX_BODY_MB = 64
+
+DEADLINE_HEADER = "X-FAA-Deadline-Ms"
 
 
 def build_policy_tensor(spec: str) -> np.ndarray:
@@ -45,8 +83,6 @@ def build_policy_tensor(spec: str) -> np.ndarray:
     Accepts a path to a ``final_policy.json`` (the search's decoded
     sub-policy list) or a shipped archive name
     (``policies/archive.py``, e.g. ``fa_reduced_cifar10``)."""
-    import os
-
     from fast_autoaugment_tpu.policies.archive import (
         load_policy,
         policy_to_tensor,
@@ -74,26 +110,146 @@ def _seed_keys(seeds) -> np.ndarray:
     return np.asarray(jax.vmap(jax.random.PRNGKey)(seeds), np.uint32)
 
 
-def make_handler(server, applier):
-    """The request handler bound to one PolicyServer instance."""
+class ServeState:
+    """The mutable serving-process state the handler, the signal
+    handlers and the supervision threads share: the live server, the
+    reload recipe, the shutdown path and the process exit code."""
+
+    def __init__(self, server, policy_spec: str, build_applier=None):
+        self.server = server
+        self.policy_spec = policy_spec
+        self.build_applier = build_applier  # policy tensor -> applier
+        self.httpd = None
+        self.exit_code = 0
+        self.stop_event = threading.Event()
+        self.reload_lock = threading.Lock()
+        self.started_at = time.time()
+
+    # ------------------------------------------------------- readiness
+
+    def ready(self) -> tuple[bool, str]:
+        srv = self.server
+        worker = srv._worker
+        if worker is None or not worker.is_alive():
+            return False, "worker not running"
+        if srv.draining:
+            return False, "draining"
+        if srv.breaker.is_open():
+            return False, "circuit breaker open"
+        return True, "ok"
+
+    # ------------------------------------------------------ hot reload
+
+    def reload_policy(self, spec: str | None = None) -> dict:
+        """Build a fresh applier (AOT-warming every padded shape OFF TO
+        THE SIDE — live traffic keeps dispatching on the old one) and
+        atomically swap it in.  Serialized: a concurrent reload gets a
+        loud error instead of racing."""
+        if self.build_applier is None:
+            raise RuntimeError("reload not configured on this server")
+        if not self.reload_lock.acquire(blocking=False):
+            raise BlockingIOError("a reload is already in progress")
+        try:
+            spec = spec or self.policy_spec
+            t0 = time.perf_counter()
+            policy = build_policy_tensor(spec)
+            applier = self.build_applier(policy)
+            info = self.server.swap_applier(applier)
+            info.update(policy=spec,
+                        warm_sec=round(time.perf_counter() - t0, 3))
+            logger.info("reload complete: %s", info)
+            return info
+        finally:
+            self.reload_lock.release()
+
+    # -------------------------------------------------------- shutdown
+
+    def initiate_shutdown(self, *, drain: bool, exit_code: int = 0,
+                          drain_timeout: float = 30.0) -> None:
+        """Run the shutdown sequence in a daemon thread: optionally
+        drain (finish in-flight work), then stop the HTTP loop."""
+        self.exit_code = exit_code
+        self.stop_event.set()
+
+        def _go():
+            if drain:
+                drained = self.server.drain(timeout=drain_timeout)
+                logger.info("graceful drain %s",
+                            "complete" if drained else "TIMED OUT")
+            else:
+                self.server.stop(timeout=5.0)
+            if self.httpd is not None:
+                self.httpd.shutdown()
+
+        threading.Thread(target=_go, daemon=True,
+                         name="serve-shutdown").start()
+
+
+def make_handler(server, applier, state: ServeState | None = None,
+                 max_body_bytes: int = DEFAULT_MAX_BODY_MB * 1024 * 1024,
+                 max_inflight: int = 0):
+    """The request handler bound to one PolicyServer instance.
+
+    `state` arms the hardened surface (/readyz, /reload); without it
+    (library/test use) those endpoints answer with a structured 503.
+    `max_inflight` > 0 bounds concurrent /augment handler threads — a
+    burst beyond it gets an immediate 503 instead of a parked thread
+    (the threaded HTTP server must not hold a thread per queued
+    request; admission itself never blocks either)."""
+    from fast_autoaugment_tpu.serve.policy_server import (
+        DeadlineExpiredError,
+        ServeError,
+        ServerOverloadedError,
+        ServerStoppedError,
+    )
+
+    inflight = (threading.BoundedSemaphore(max_inflight)
+                if max_inflight > 0 else None)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # route through our logger
             logger.info("http: " + fmt, *args)
 
-        def _send(self, code: int, body: bytes, ctype: str) -> None:
+        def _send(self, code: int, body: bytes, ctype: str,
+                  headers: dict | None = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _send_json(self, code: int, obj) -> None:
-            self._send(code, json.dumps(obj).encode(), "application/json")
+        def _send_json(self, code: int, obj,
+                       headers: dict | None = None) -> None:
+            self._send(code, json.dumps(obj).encode(), "application/json",
+                       headers)
+
+        def _send_error_json(self, code: int, err_type: str, msg: str,
+                             retry_after_s: float | None = None) -> None:
+            headers = {}
+            if retry_after_s is not None:
+                # ceil to whole seconds (Retry-After is integral)
+                headers["Retry-After"] = str(max(1, int(retry_after_s + 0.999)))
+            self._send_json(code, {"error": msg, "type": err_type}, headers)
+
+        # ------------------------------------------------------- GETs
 
         def do_GET(self):
             if self.path == "/healthz":
+                # LIVENESS: the process is running — stays 200 through
+                # overload, drain and an open breaker (that is what
+                # /readyz is for)
                 self._send_json(200, {"ok": True})
+                return
+            if self.path == "/readyz":
+                if state is None:
+                    self._send_error_json(503, "not_configured",
+                                          "readiness not configured")
+                    return
+                ok, reason = state.ready()
+                self._send_json(200 if ok else 503,
+                                {"ready": ok, "reason": reason})
                 return
             if self.path == "/stats":
                 from fast_autoaugment_tpu.core.compilecache import (
@@ -103,37 +259,201 @@ def make_handler(server, applier):
                 stats = server.stats()
                 stats["compile_cache"] = compile_cache_stats()
                 stats["aot_compile"] = {
-                    str(s): r for s, r in applier.compile_log.items()}
+                    str(s): r for s, r in getattr(
+                        server.applier, "compile_log", {}).items()}
                 self._send_json(200, stats)
                 return
-            self._send_json(404, {"error": f"unknown path {self.path}"})
+            self._send_error_json(404, "unknown_path",
+                                  f"unknown path {self.path}")
 
-        def do_POST(self):
-            if self.path != "/augment":
-                self._send_json(404, {"error": f"unknown path {self.path}"})
-                return
+        # ------------------------------------------------------ POSTs
+
+        def _read_body(self) -> bytes | None:
+            """Bounded body read; answers 413/400 itself on refusal."""
             try:
                 length = int(self.headers.get("Content-Length", "0"))
-                payload = np.load(io.BytesIO(self.rfile.read(length)),
-                                  allow_pickle=False)
-                images = np.asarray(payload["images"])
-                if images.ndim == 3:
-                    images = images[None]
-                keys = None
-                if "seeds" in payload.files:
-                    keys = _seed_keys(payload["seeds"])
-                out = server.augment(images, keys)
-            except (KeyError, ValueError, OSError) as e:
-                self._send_json(400, {"error": f"{type(e).__name__}: {e}"})
+            except ValueError:
+                self._send_error_json(400, "bad_request",
+                                      "malformed Content-Length")
+                return None
+            if length <= 0:
+                self._send_error_json(400, "bad_request",
+                                      "empty or missing body")
+                return None
+            if length > max_body_bytes:
+                self._send_error_json(
+                    413, "body_too_large",
+                    f"body of {length} bytes exceeds the "
+                    f"{max_body_bytes}-byte bound")
+                return None
+            return self.rfile.read(length)
+
+        def _deadline_ms(self) -> float | None:
+            raw = self.headers.get(DEADLINE_HEADER)
+            if raw is None:
+                return None
+            try:
+                ms = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"malformed {DEADLINE_HEADER} header {raw!r}")
+            if ms <= 0:
+                raise ValueError(f"{DEADLINE_HEADER} must be > 0, got {ms}")
+            return ms
+
+        def _do_augment(self) -> None:
+            if inflight is not None and not inflight.acquire(blocking=False):
+                self._send_error_json(
+                    503, "handler_overloaded",
+                    "all handler slots busy — retry", retry_after_s=0.1)
                 return
-            except TimeoutError as e:
-                self._send_json(503, {"error": str(e)})
+            try:
+                body = self._read_body()
+                if body is None:
+                    return
+                try:
+                    deadline_ms = self._deadline_ms()
+                    payload = np.load(io.BytesIO(body), allow_pickle=False)
+                    images = np.asarray(payload["images"])
+                    if images.ndim == 3:
+                        images = images[None]
+                    keys = None
+                    if "seeds" in payload.files:
+                        keys = _seed_keys(payload["seeds"])
+                    pending = server.submit(images, keys,
+                                            deadline_ms=deadline_ms)
+                    out = server.result(pending)
+                except TimeoutError as e:
+                    # NOTE: before the OSError catch — TimeoutError IS
+                    # an OSError subclass and must not read as a 400
+                    self._send_error_json(503, "timeout", str(e))
+                    return
+                except (KeyError, ValueError, OSError) as e:
+                    self._send_error_json(400, "bad_request",
+                                          f"{type(e).__name__}: {e}")
+                    return
+                except ServerOverloadedError as e:
+                    self._send_error_json(429, "overloaded", str(e),
+                                          retry_after_s=e.retry_after_s)
+                    return
+                except CircuitOpenError as e:
+                    self._send_error_json(503, "breaker_open", str(e),
+                                          retry_after_s=e.retry_after_s)
+                    return
+                except ServerStoppedError as e:
+                    self._send_error_json(503, "draining", str(e))
+                    return
+                except DeadlineExpiredError as e:
+                    self._send_error_json(503, "deadline_expired", str(e))
+                    return
+                except ServeError as e:
+                    self._send_error_json(500, "dispatch_error", str(e))
+                    return
+                buf = io.BytesIO()
+                np.savez(buf, images=np.clip(out, 0, 255).astype(np.uint8))
+                self._send(200, buf.getvalue(), "application/octet-stream")
+            finally:
+                if inflight is not None:
+                    inflight.release()
+
+        def _do_reload(self) -> None:
+            if state is None:
+                self._send_error_json(503, "not_configured",
+                                      "reload not configured")
                 return
-            buf = io.BytesIO()
-            np.savez(buf, images=np.clip(out, 0, 255).astype(np.uint8))
-            self._send(200, buf.getvalue(), "application/octet-stream")
+            spec = None
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            if length > 0:
+                if length > max_body_bytes:
+                    self._send_error_json(413, "body_too_large",
+                                          "reload body too large")
+                    return
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    spec = req.get("policy")
+                except (ValueError, AttributeError):
+                    self._send_error_json(400, "bad_request",
+                                          "reload body must be JSON "
+                                          '{"policy": PATH}')
+                    return
+            try:
+                info = state.reload_policy(spec)
+            except BlockingIOError as e:
+                self._send_error_json(409, "reload_in_progress", str(e))
+                return
+            except (ValueError, OSError, RuntimeError) as e:
+                self._send_error_json(400, "reload_failed",
+                                      f"{type(e).__name__}: {e}")
+                return
+            self._send_json(200, {"reloaded": True, **info})
+
+        def do_POST(self):
+            try:
+                if self.path == "/augment":
+                    self._do_augment()
+                elif self.path == "/reload":
+                    self._do_reload()
+                else:
+                    self._send_error_json(404, "unknown_path",
+                                          f"unknown path {self.path}")
+            except Exception as e:  # noqa: BLE001 — never a bare traceback
+                logger.error("http handler failed on %s: %s", self.path, e)
+                try:
+                    self._send_error_json(500, "internal",
+                                          f"{type(e).__name__}: {e}")
+                except OSError:
+                    pass  # robust: allow — client already gone
 
     return Handler
+
+
+class _ServeHTTPServer(ThreadingHTTPServer):
+    # handler threads must not block interpreter exit after shutdown()
+    daemon_threads = True
+
+
+# ---------------------------------------------------- fleet integration
+
+
+def _write_beat(path: str, tag: str, done: bool = False) -> None:
+    """Atomic host-beat write in the fleet/workqueue schema
+    (``hosts/<tag>.json``) so ``launch/fleet.py --heartbeat-timeout``
+    can SIGKILL a wedged serving replica."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"owner": tag, "heartbeat": time.time(), "done": done}, fh)
+    os.replace(tmp, path)
+
+
+def _beat_loop(state: ServeState, beat_dir: str, tag: str,
+               interval_s: float) -> None:
+    host_dir = os.path.join(beat_dir, "hosts")
+    os.makedirs(host_dir, exist_ok=True)
+    path = os.path.join(host_dir, f"{tag}.json")
+    while not state.stop_event.wait(interval_s):
+        try:
+            _write_beat(path, tag)
+        except OSError as e:
+            logger.warning("host beat write failed: %s", e)
+    try:
+        _write_beat(path, tag, done=True)
+    except OSError as e:
+        logger.warning("final host beat write failed: %s", e)
+
+
+def _breaker_exit_loop(state: ServeState, poll_s: float = 0.2) -> None:
+    """``--breaker-exit``: a latched-open breaker turns into exit 77 —
+    "restart me" — so a fleet supervisor relaunches the replica instead
+    of load-balancers routing at a permanently-broken backend."""
+    while not state.stop_event.wait(poll_s):
+        snap = state.server.breaker.snapshot()
+        if snap["state"] == "open":
+            logger.error(
+                "circuit breaker open with --breaker-exit: shutting down "
+                "with exit %d for supervised restart", PREEMPTED_EXIT_CODE)
+            state.initiate_shutdown(drain=False,
+                                    exit_code=PREEMPTED_EXIT_CODE)
+            return
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -164,6 +484,69 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of re-lowering them (core/compilecache.py)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8765)
+    # ---------------- overload / resilience knobs (defaults = PR-7
+    # clean-weather behavior, except fail-fast admission) ------------
+    p.add_argument("--queue-depth", type=int, default=4096,
+                   help="bounded request queue; a full queue answers 429 "
+                        "+ Retry-After IMMEDIATELY (admission never "
+                        "blocks a handler thread)")
+    p.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="deadline stamped on requests without an "
+                        f"{DEADLINE_HEADER} header; expired requests are "
+                        "shed before dispatch (default: none)")
+    p.add_argument("--lifo-depth", type=int, default=0,
+                   help="queue depth at/above which draining flips to "
+                        "adaptive-LIFO (newest-first) — under sustained "
+                        "overload the oldest requests are the ones whose "
+                        "clients already gave up.  0 = pure FIFO")
+    p.add_argument("--lifo-age-ms", type=float, default=0.0,
+                   help="oldest-queued-request age that flips draining to "
+                        "adaptive-LIFO.  0 = off")
+    p.add_argument("--breaker-threshold", type=int, default=0,
+                   help="consecutive dispatch failures that open the "
+                        "circuit breaker (fail fast + /readyz 503).  "
+                        "0 = breaker disabled")
+    p.add_argument("--breaker-cooldown", type=float, default=5.0,
+                   help="seconds an open breaker fails fast before "
+                        "admitting one half-open probe")
+    p.add_argument("--dispatch-timeout", type=float, default=0.0,
+                   help="dispatch wall above this counts as a breaker "
+                        "failure even when results arrive (a straggler "
+                        "budget; pairs with --watchdog for true hangs).  "
+                        "0 = off")
+    p.add_argument("--breaker-exit", action="store_true",
+                   help="exit 77 ('restart me') when the breaker opens — "
+                        "under fleet supervision (--no-rank-args) the "
+                        "replica is relaunched and returns to ready")
+    p.add_argument("--watchdog", default="off", metavar="{off,auto,SECONDS}",
+                   help="deadline-guard each AOT dispatch "
+                        "(core/watchdog.py); serve labels are AOT-loaded "
+                        "so their first call gets the bounded warm "
+                        "allowance, and a fired watchdog is a breaker "
+                        "failure")
+    p.add_argument("--max-body-mb", type=int, default=DEFAULT_MAX_BODY_MB,
+                   help="POST body bound; larger bodies answer 413")
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="bound on concurrent /augment handler threads; a "
+                        "burst beyond it answers 503 immediately instead "
+                        "of parking a thread per queued request.  0 = "
+                        "unbounded (historical)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="seconds the SIGTERM graceful drain waits for "
+                        "in-flight requests before exiting anyway")
+    p.add_argument("--serve-seconds", type=float, default=0.0,
+                   help="gracefully drain and exit 0 after this many "
+                        "seconds (bounded drills / tests).  0 = serve "
+                        "forever")
+    p.add_argument("--heartbeat-dir", default=None, metavar="DIR",
+                   help="write fleet-schema host beats to "
+                        "DIR/hosts/<tag>.json so a fleet supervisor's "
+                        "--heartbeat-timeout can SIGKILL a wedged replica")
+    p.add_argument("--host-tag", default=None,
+                   help="host beat tag (default host<FAA_HOST_ID or 0>)")
+    p.add_argument("--port-file", default=None, metavar="PATH",
+                   help="write the BOUND port (supports --port 0) to PATH "
+                        "— how supervised tests find the replica")
     return p
 
 
@@ -173,18 +556,33 @@ def main(argv=None):
         compile_cache_stats,
         configure_compile_cache,
     )
+    from fast_autoaugment_tpu.core.watchdog import resolve_watchdog
     from fast_autoaugment_tpu.serve.policy_server import (
         AotPolicyApplier,
         PolicyServer,
     )
 
     configure_compile_cache(args.compile_cache)
-    policy = build_policy_tensor(args.policy)
     shapes = tuple(int(s) for s in str(args.shapes).split(",") if s)
-    applier = AotPolicyApplier(policy, image=args.image, shapes=shapes,
-                               dispatch=args.dispatch, groups=args.groups)
-    server = PolicyServer(applier, max_batch=args.max_batch,
-                          max_wait_ms=args.max_wait_ms).start()
+    watchdog = resolve_watchdog(args.watchdog)
+
+    def build_applier(policy_tensor):
+        return AotPolicyApplier(
+            policy_tensor, image=args.image, shapes=shapes,
+            dispatch=args.dispatch, groups=args.groups,
+            watchdog=watchdog if watchdog.enabled else None)
+
+    policy = build_policy_tensor(args.policy)
+    applier = build_applier(policy)
+    server = PolicyServer(
+        applier, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        default_deadline_ms=args.default_deadline_ms,
+        lifo_depth=args.lifo_depth, lifo_age_ms=args.lifo_age_ms,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown,
+        dispatch_timeout_s=args.dispatch_timeout).start()
+    state = ServeState(server, args.policy, build_applier)
     cc = compile_cache_stats()
     logger.info(
         "serving %d sub-policies (dispatch=%s) at http://%s:%d — AOT "
@@ -193,21 +591,65 @@ def main(argv=None):
         {s: r["sec"] for s, r in applier.compile_log.items()},
         cc["hits"], cc["misses"])
 
-    httpd = ThreadingHTTPServer((args.host, args.port),
-                                make_handler(server, applier))
+    httpd = _ServeHTTPServer(
+        (args.host, args.port),
+        make_handler(server, applier, state=state,
+                     max_body_bytes=args.max_body_mb * 1024 * 1024,
+                     max_inflight=args.max_inflight))
+    state.httpd = httpd
+    bound_port = httpd.server_address[1]
+    if args.port_file:
+        with open(args.port_file, "w") as fh:
+            fh.write(str(bound_port))
+    logger.info("listening on http://%s:%d (readyz/healthz/stats/"
+                "augment/reload)", args.host, bound_port)
 
     def shutdown(signum, frame):
-        logger.info("signal %d: shutting down", signum)
-        threading.Thread(target=httpd.shutdown, daemon=True).start()
+        # graceful drain: stop admitting, finish in-flight, exit 0 —
+        # the serving arm of the exit-code contract
+        logger.info("signal %d: draining and shutting down", signum)
+        state.initiate_shutdown(drain=True, exit_code=0,
+                                drain_timeout=args.drain_timeout)
+
+    def reload_sig(signum, frame):
+        logger.info("SIGHUP: hot policy reload")
+
+        def _go():
+            try:
+                state.reload_policy()
+            except (BlockingIOError, ValueError, OSError,
+                    RuntimeError) as e:
+                logger.error("SIGHUP reload failed: %s", e)
+
+        threading.Thread(target=_go, daemon=True, name="sighup-reload").start()
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGHUP, reload_sig)
+
+    if args.breaker_exit:
+        threading.Thread(target=_breaker_exit_loop, args=(state,),
+                         daemon=True, name="breaker-exit").start()
+    if args.heartbeat_dir:
+        tag = args.host_tag or f"host{os.environ.get('FAA_HOST_ID', '0')}"
+        threading.Thread(target=_beat_loop,
+                         args=(state, args.heartbeat_dir, tag, 1.0),
+                         daemon=True, name="host-beat").start()
+    if args.serve_seconds > 0:
+        timer = threading.Timer(
+            args.serve_seconds,
+            lambda: state.initiate_shutdown(
+                drain=True, exit_code=0, drain_timeout=args.drain_timeout))
+        timer.daemon = True
+        timer.start()
+
     try:
         httpd.serve_forever()
     finally:
+        state.stop_event.set()
         httpd.server_close()
         server.stop()
-    return 0
+    return state.exit_code
 
 
 if __name__ == "__main__":

@@ -49,6 +49,16 @@ Kinds and their trigger coordinates:
     Work-queue heartbeat renewals for lease unit NAME are silently
     dropped from the first match onward (a wedged heartbeat thread) —
     drives the stale-lease reclaim path (``launch/workqueue.py``).
+``serve_error@dispatch=N``
+    The policy server's N-th coalesced dispatch (1-based attempt
+    counter) raises before touching the device — a failing serving
+    backend; repeated specs drive the circuit breaker open
+    (``serve/policy_server.py``).
+``serve_slow@dispatch=N,factor=F``
+    The policy server's N-th dispatch takes F x the server's dispatch
+    wall EMA extra (F seconds before any observation) — a serving
+    straggler; with a ``dispatch_timeout_s`` configured the overtime
+    counts as a breaker failure even though the results are delivered.
 
 Each step/save/trial-pinned spec fires exactly ONCE per process (the
 counter-based kinds are consumed when hit); ``io_error`` fires per its
@@ -56,7 +66,7 @@ Bernoulli stream; ``stale_lease`` latches (every later renewal for the
 unit stays dropped).  Tests in the same process call :func:`reset`
 after mutating ``os.environ['FAA_FAULT']``.
 
-Process-chain gating: the signal/hang/slow kinds accept an optional
+Process-chain gating: the signal/hang/slow/serve_* kinds accept an optional
 ``attempt=N`` key — the spec fires only when ``FAA_ATTEMPT`` (exported
 by the fleet supervisor as the per-host launch counter, default 1)
 equals N.  A relaunched process otherwise re-reads the same
@@ -90,6 +100,8 @@ _KINDS = {
     "hang": ("step", "attempt"),
     "slow": ("step", "factor", "attempt"),
     "stale_lease": ("unit",),
+    "serve_error": ("dispatch", "attempt"),
+    "serve_slow": ("dispatch", "factor", "attempt"),
 }
 
 # keys that are optional for their kind (everything else is required)
@@ -240,6 +252,19 @@ class FaultPlan:
         if self._take("hang", "step", step, at_least=True):
             return ("hang", float("inf"))
         f = self._take("slow", "step", step, at_least=True)
+        if f is not None:
+            return ("slow", float(f["factor"]))
+        return None
+
+    def serve_fault(self, dispatch_n: int) -> tuple[str, float] | None:
+        """Consult the serve_error/serve_slow verbs at the policy
+        server's dispatch seam with the 1-based dispatch-attempt
+        counter.  Returns ``("error", 0.0)``, ``("slow", factor)``, or
+        None.  The caller turns "slow" into ``factor x dispatch-wall
+        EMA`` extra seconds (factor seconds before any observation)."""
+        if self._take("serve_error", "dispatch", dispatch_n):
+            return ("error", 0.0)
+        f = self._take("serve_slow", "dispatch", dispatch_n)
         if f is not None:
             return ("slow", float(f["factor"]))
         return None

@@ -191,6 +191,72 @@ def test_r5_robust_allow_suppression():
     assert "R5" not in _rules(check_source(src, TRAIN_SCOPE))
 
 
+def test_r6_unbounded_queue_put_flagged():
+    """The blocking-admission bug class: Queue.put without a timeout
+    in serve/ parks a handler thread on a full queue."""
+    src = "import queue\nq = queue.Queue()\nq.put(item)\n"
+    assert _rules(check_source(src, SERVE_SCOPE)) == ["R6"]
+
+
+def test_r6_bounded_and_nonblocking_put_ok():
+    src = ("import queue\nq = queue.Queue()\n"
+           "q.put(item, False)\nq.put(item, timeout=1.0)\n"
+           "q.put(item, block=False)\n")
+    assert not check_source(src, SERVE_SCOPE)
+
+
+def test_r6_event_wait_via_attribute_suffix():
+    """Cross-object receivers match by constructor-bound attribute
+    suffix: pending.event.wait() is caught through the self.event =
+    Event() construction elsewhere in the file."""
+    src = ("import threading\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self.event = threading.Event()\n"
+           "def wait_for(p):\n"
+           "    p.event.wait()\n")
+    assert _rules(check_source(src, SERVE_SCOPE)) == ["R6"]
+    timed = src.replace("p.event.wait()", "p.event.wait(timeout=2.0)")
+    assert not check_source(timed, SERVE_SCOPE)
+
+
+def test_r6_untimed_thread_join_and_queue_get_flagged():
+    src = ("import threading, queue\n"
+           "t = threading.Thread(target=f)\nq = queue.Queue()\n"
+           "t.join()\nq.get()\n")
+    assert _rules(check_source(src, SERVE_SCOPE)) == ["R6", "R6"]
+
+
+def test_r6_bare_sleep_loop_flagged():
+    src = "import time\nwhile not done():\n    time.sleep(0.5)\n"
+    assert _rules(check_source(src, SERVE_SCOPE)) == ["R6"]
+    # a one-shot sleep outside a loop is not a poll loop
+    assert not check_source("import time\ntime.sleep(0.5)\n", SERVE_SCOPE)
+    # the bounded idiom: Event.wait(timeout) as the loop condition
+    ok = ("import threading\nevt = threading.Event()\n"
+          "while not evt.wait(0.5):\n    poll()\n")
+    assert not check_source(ok, SERVE_SCOPE)
+
+
+def test_r6_out_of_scope_dirs_not_flagged():
+    src = ("import queue, time\nq = queue.Queue()\nq.put(item)\n"
+           "while True:\n    time.sleep(0.1)\n")
+    for scope in (OUT_SCOPE, TRAIN_SCOPE, BLOCK_SCOPE):
+        assert "R6" not in _rules(check_source(src, scope)), scope
+
+
+def test_r6_str_join_dict_get_never_flagged():
+    src = ("x = ','.join(items)\nd = {}\nd.get('k')\n"
+           "class C:\n    pass\n")
+    assert not check_source(src, SERVE_SCOPE)
+
+
+def test_r6_robust_allow_suppression():
+    src = ("import queue\nq = queue.Queue()\n"
+           "q.put(item)  # robust: allow — bounded by construction\n")
+    assert not check_source(src, SERVE_SCOPE)
+
+
 def test_repo_is_clean():
     """The live gate: the package must hold the discipline the
     resilience subsystem depends on (make lint-robust)."""

@@ -1,5 +1,6 @@
 """AOT policy-serving benchmark: p50/p99 latency + imgs/s at fixed
-offered QPS (``make bench-serve``).
+offered QPS (``make bench-serve``), and the OVERLOAD drill
+(``make bench-overload``).
 
 Drives the real serving pair — :class:`AotPolicyApplier` (AOT-compiled
 padded-shape executables) behind :class:`PolicyServer` (batch
@@ -13,12 +14,27 @@ JSON line reports:
 - ``aot_compile_sec`` per shape + the unified ``compile_cache`` block
   (with ``FAA_COMPILE_CACHE`` set, a re-run deserializes the
   executables — the warm-start story applied to serving);
+- ``serve_robustness``: the admission/shed/breaker/reload counters
+  (docs/RESILIENCE.md "Serving under overload");
 - the standard contention + shadow-watchdog stamps, plus a per-run
   ``bitwise_match`` re-verification that exact-dispatch served outputs
   equal direct ``apply_policy`` application.
 
+``--overload`` sweeps offered QPS PAST capacity (calibrated
+closed-loop, then ``--multipliers`` x capacity) twice — shedding ON
+(bounded queue + per-request deadline + adaptive-LIFO watermarks) vs
+OFF (the unbounded clean-weather config) — and reports per arm:
+goodput (admitted requests completing within the deadline, per
+second), shed rate, deadline-miss rate of admitted, and p50/p99 of
+ADMITTED requests.  The acceptance shape: with shedding on, goodput
+holds near the clean-weather plateau while p99-of-admitted stays
+bounded; with shedding off, every request "succeeds" into a queue
+whose latency has already collapsed past the deadline.
+
     python tools/bench_serve.py [--qps 200] [--seconds 5] [--image 32]
         [--dispatch auto] [--shapes 1,8,32,128]
+    python tools/bench_serve.py --overload [--multipliers 1,2,4]
+        [--deadline-ms 100] [--overload-seconds 2]
 """
 
 from __future__ import annotations
@@ -112,6 +128,174 @@ def run_offered_load(server, images_pool, qps: float, seconds: float,
     }
 
 
+def _robustness_stamp(stats: dict) -> dict:
+    """The flat serve-robustness block every bench JSON line carries
+    (admitted/shed/expired/breaker_fires/reloads — BENCH rounds track
+    them alongside latency)."""
+    adm = stats.get("admission", {})
+    brk = stats.get("breaker", {})
+    return {
+        "admitted": adm.get("admitted", 0),
+        "shed_overload": adm.get("shed_overload", 0),
+        "shed_breaker": adm.get("shed_breaker", 0),
+        "expired": adm.get("expired", 0),
+        "deadline_misses": adm.get("deadline_misses", 0),
+        "lifo_takes": adm.get("lifo_takes", 0),
+        "breaker_fires": brk.get("fires", 0),
+        "breaker_state": brk.get("state", "disabled"),
+        "reloads": stats.get("reloads", 0),
+    }
+
+
+def calibrate_capacity(make_server, images_pool, imgs_per_request: int,
+                       seconds: float = 0.75) -> float:
+    """Closed-loop capacity estimate: keep ``2 x max_batch`` requests
+    in flight for `seconds`, return achieved requests/s — the
+    saturation throughput the overload multipliers scale from."""
+    server = make_server()
+    try:
+        n_window = max(2, 2 * server.max_batch)
+        done = 0
+        t0 = time.perf_counter()
+        inflight = []
+        while time.perf_counter() - t0 < seconds:
+            while len(inflight) < n_window:
+                lo = done % (images_pool.shape[0] - imgs_per_request + 1)
+                inflight.append(
+                    server.submit(images_pool[lo:lo + imgs_per_request]))
+                done += 1
+            server.result(inflight.pop(0), timeout=60.0)
+        for p in inflight:
+            server.result(p, timeout=60.0)
+        wall = time.perf_counter() - t0
+        return done / wall
+    finally:
+        server.stop()
+
+
+def run_overload_arm(server, images_pool, qps: float, seconds: float,
+                     imgs_per_request: int, deadline_ms: float,
+                     shed: bool) -> dict:
+    """One overload arm: open-loop offered load at `qps`, submissions
+    never block (typed rejections counted as shed), goodput = admitted
+    requests completing WITHIN the deadline."""
+    import numpy as np
+
+    from fast_autoaugment_tpu.serve.policy_server import ServeError
+
+    n_requests = max(1, int(qps * seconds))
+    interval = 1.0 / qps
+    admitted, shed_n = [], 0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        sched = t0 + i * interval
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        lo = (i * imgs_per_request) % (images_pool.shape[0]
+                                       - imgs_per_request + 1)
+        try:
+            # shedding-on stamps the deadline; the off arm submits the
+            # clean-weather way (no deadline, unbounded queue)
+            admitted.append(server.submit(
+                images_pool[lo:lo + imgs_per_request],
+                deadline_ms=deadline_ms if shed else None))
+        except ServeError:
+            shed_n += 1
+    good_lat, completed_lat, miss_n = [], [], 0
+    for p in admitted:
+        try:
+            server.result(p, timeout=120.0)
+        except ServeError:
+            miss_n += 1  # shed in queue (deadline) or failed
+            continue
+        except TimeoutError:
+            miss_n += 1
+            continue
+        lat = p.latency()
+        completed_lat.append(lat)
+        if lat * 1e3 <= deadline_ms:
+            good_lat.append(lat)
+        else:
+            miss_n += 1  # completed, but past the deadline budget
+    wall = (max((p.t_done for p in admitted), default=time.perf_counter())
+            - t0)
+    # percentiles over requests that were admitted AND served — a shed
+    # request's t_done is its error delivery, not a service latency
+    lat_ms = (np.asarray(completed_lat) * 1e3 if completed_lat
+              else np.asarray([0.0]))
+    return {
+        "shedding": "on" if shed else "off",
+        "qps_offered": round(qps, 1),
+        "requests_offered": n_requests,
+        "admitted": len(admitted),
+        "shed": shed_n,
+        "shed_rate": round(shed_n / n_requests, 4),
+        "goodput_rps": round(len(good_lat) / wall, 1) if wall > 0 else 0.0,
+        "deadline_miss_rate": (round(miss_n / len(admitted), 4)
+                               if admitted else 0.0),
+        "admitted_latency_ms": {
+            "p50": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99": round(float(np.percentile(lat_ms, 99)), 3),
+            "max": round(float(lat_ms.max()), 3),
+        },
+    }
+
+
+def run_overload(args, applier, pool) -> dict:
+    """The full overload sweep: calibrate capacity, then every
+    multiplier x capacity with shedding on and off."""
+    from fast_autoaugment_tpu.serve.policy_server import PolicyServer
+
+    # the drill serves ONE request per dispatch (requests carry
+    # --overload-imgs-per-request images, default 32): with full
+    # coalescing of 1-image requests this host's submit loop cannot
+    # offer more than the device serves and nothing ever queues — the
+    # drill is about queue behavior, not batching efficiency
+    imgs_per_request = max(1, args.overload_imgs_per_request)
+    max_batch = max(imgs_per_request, args.overload_max_batch)
+
+    def make_server(shed: bool = False):
+        if shed:
+            return PolicyServer(
+                applier, max_batch=max_batch,
+                max_wait_ms=args.max_wait_ms,
+                queue_depth=args.overload_queue_depth,
+                default_deadline_ms=args.deadline_ms,
+                lifo_depth=max(2, args.overload_queue_depth // 2),
+                lifo_age_ms=args.deadline_ms / 2).start()
+        return PolicyServer(applier, max_batch=max_batch,
+                            max_wait_ms=args.max_wait_ms).start()
+
+    capacity = calibrate_capacity(lambda: make_server(False), pool,
+                                  imgs_per_request)
+    multipliers = [float(m) for m in str(args.multipliers).split(",") if m]
+    rows = []
+    last_stats = {}
+    for shed in (True, False):
+        for m in multipliers:
+            server = make_server(shed)
+            try:
+                row = run_overload_arm(
+                    server, pool, m * capacity, args.overload_seconds,
+                    imgs_per_request, args.deadline_ms, shed)
+            finally:
+                stats = server.stats()
+                server.stop()
+            row["multiplier"] = m
+            row["serve_robustness"] = _robustness_stamp(stats)
+            rows.append(row)
+            last_stats = stats
+    return {
+        "capacity_qps": round(capacity, 1),
+        "deadline_ms": args.deadline_ms,
+        "imgs_per_request": imgs_per_request,
+        "overload_queue_depth": args.overload_queue_depth,
+        "arms": rows,
+        "serving": last_stats,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--policy", default=None,
@@ -128,6 +312,28 @@ def main(argv=None) -> int:
     p.add_argument("--qps", type=float, default=200.0)
     p.add_argument("--seconds", type=float, default=5.0)
     p.add_argument("--imgs-per-request", type=int, default=1)
+    # ------------------------------------------------- overload drill
+    p.add_argument("--overload", action="store_true",
+                   help="sweep offered QPS past calibrated capacity, "
+                        "shedding on vs off (make bench-overload)")
+    p.add_argument("--multipliers", default="1,2,4",
+                   help="offered-QPS multipliers over calibrated capacity")
+    p.add_argument("--deadline-ms", type=float, default=100.0,
+                   help="per-request deadline budget in the overload "
+                        "drill (shed + goodput reference)")
+    p.add_argument("--overload-seconds", type=float, default=2.0,
+                   help="seconds of offered load per overload arm")
+    p.add_argument("--overload-queue-depth", type=int, default=64,
+                   help="bounded queue depth for the shedding-on arms")
+    p.add_argument("--overload-max-batch", type=int, default=1,
+                   help="coalescer cap during the drill (defaults to the "
+                        "per-request image count = one request per "
+                        "dispatch, so offered load can actually exceed "
+                        "served capacity on a small host)")
+    p.add_argument("--overload-imgs-per-request", type=int, default=32,
+                   help="images per request in the drill: enough device "
+                        "work per dispatch that the open-loop generator "
+                        "can out-offer the served rate")
     args = p.parse_args(argv)
 
     from bench import (
@@ -180,6 +386,31 @@ def main(argv=None) -> int:
                   else np.asarray(jax.random.PRNGKey(7), np.uint32))
     bitwise = verify_bitwise(applier, pool[:n_check], check_keys)
 
+    if args.overload:
+        # warm the dispatch path once, then run the sweep
+        warm = PolicyServer(applier, max_wait_ms=args.max_wait_ms).start()
+        warm.augment(pool[:1])
+        warm.stop()
+        sweep = run_overload(args, applier, pool)
+        out = {
+            "metric": "serve_overload_goodput",
+            "backend": jax.devices()[0].platform,
+            "policy": args.policy or f"synthetic_{args.num_sub}sub",
+            "num_sub": int(policy.shape[0]),
+            "image": args.image,
+            "dispatch": applier.dispatch,
+            "shapes": list(applier.shapes),
+            "max_wait_ms": args.max_wait_ms,
+            "imgs_per_request": args.imgs_per_request,
+            **sweep,
+            "bitwise_match": bitwise,
+            "aot_compile_sec_total": round(aot_secs, 3),
+            "compile_cache": compile_cache_stats(),
+            "contention": contention,
+        }
+        print(json.dumps(out))
+        return 0 if bitwise else 4
+
     server = PolicyServer(applier, max_wait_ms=args.max_wait_ms).start()
     # warm the dispatch path (first calls already AOT-compiled)
     server.augment(pool[:1])
@@ -201,6 +432,7 @@ def main(argv=None) -> int:
         "imgs_per_request": args.imgs_per_request,
         **load,
         "serving": stats,
+        "serve_robustness": _robustness_stamp(stats),
         "bitwise_match": bitwise,
         "aot_compile_sec_total": round(aot_secs, 3),
         "aot_compile": {str(s): r for s, r in applier.compile_log.items()},

@@ -39,6 +39,19 @@ R5  **no direct ``jax.jit`` outside the compile seam** in ``train/``,
     reintroduces the invisible 23-55 s compile tax the cache
     subsystem exists to measure and kill.
 
+R6  **no unbounded blocking in the serving hot path** (``serve/``):
+    a ``Queue.put``/``Queue.get``, ``Event``/``Condition`` ``.wait``
+    or ``Thread.join`` without a timeout, or a bare ``time.sleep``
+    inside a ``while`` loop.  The policy server's overload contract is
+    that NO thread — HTTP handler, coalescing worker, supervision
+    loop — can park forever: a blocking admission put was exactly the
+    bug that held handler threads 30 s on a full queue, and a bare
+    sleep-poll loop has no deadline to fail fast on.  Receivers are
+    tracked from Thread/Queue/Event/Condition constructors in the same
+    file, both directly and by attribute suffix (``pending.event`` is
+    matched by the ``self.event = threading.Event()`` construction in
+    the request class).
+
 Suppress a finding (sparingly, with a reason nearby) by putting
 ``robust: allow`` in a comment on the offending line.
 
@@ -74,10 +87,21 @@ BLOCKING_DIRS = ("core", "launch", "search")
 # hot paths, and the seam wraps them at the train/search call sites.
 JIT_SEAM_DIRS = ("train", "search", "serve")
 
+# R6 scope: the serving layer, where EVERY thread must stay
+# deadline-bounded (handler threads, the coalescing worker, the
+# supervision loops) — docs/RESILIENCE.md "Serving under overload".
+SERVE_BLOCKING_DIRS = ("serve",)
+
 # constructor names whose instances carry blocking .join()/.get()
 _THREAD_CTORS = {"Thread", "Timer"}
 _QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
                 "JoinableQueue"}
+# R6 additionally tracks waitable sync primitives and flags .put()
+_WAIT_CTORS = {"Event", "Condition", "Barrier"}
+#: R6 blocking methods and the positional index their timeout lands at
+#: (Queue.put(item, block, timeout) -> a bare put(item) has ONE arg and
+#: still blocks forever; get()/join()/wait() block with ZERO args)
+_R6_METHODS = {"put": 1, "get": 0, "join": 0, "wait": 0}
 
 # (relative module path suffix, function name) pairs allowed to write
 # directly: THE atomic helpers themselves.
@@ -197,13 +221,67 @@ def _has_timeout(call: ast.Call) -> bool:
     return bool(call.args) or any(kw.arg == "timeout" for kw in call.keywords)
 
 
+def _r6_bounded(call: ast.Call, method: str) -> bool:
+    """Whether an R6 blocking call is bounded/non-blocking: positional
+    args past the method's payload slot (``put(item, False)``,
+    ``get(False)``, ``wait(0.1)``) or a ``block=``/``timeout=``
+    keyword."""
+    payload_args = _R6_METHODS[method]
+    if len(call.args) > payload_args:
+        return True
+    return any(kw.arg in ("timeout", "block") for kw in call.keywords)
+
+
+def _r6_receivers(tree) -> tuple[set[str], set[str]]:
+    """(receiver keys, attribute suffixes) bound from
+    Thread/Queue/Event/Condition constructors in this file.  The
+    suffix set matches cross-object uses — ``pending.event.wait()`` is
+    caught via the ``self.event = Event()`` construction elsewhere in
+    the file."""
+    ctors = _THREAD_CTORS | _QUEUE_CTORS | _WAIT_CTORS
+    keys: set[str] = set()
+    for node in ast.walk(tree):
+        value = None
+        targets = []
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.value, ast.Call):
+            value, targets = node.value, [node.target]
+        if value is not None and _ctor_name(value) in ctors:
+            for tgt in targets:
+                key = _recv_key(tgt)
+                if key:
+                    keys.add(key)
+    suffixes = {k.split(".")[-1] for k in keys}
+    return keys, suffixes
+
+
+def _sleep_in_while(tree) -> list[ast.Call]:
+    """``time.sleep`` calls lexically inside a ``while`` body — a poll
+    loop with no deadline."""
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        for child in ast.walk(node):
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr == "sleep" \
+                    and isinstance(child.func.value, ast.Name) \
+                    and child.func.value.id == "time":
+                hits.append(child)
+    return hits
+
+
 def check_source(src: str, relpath: str,
                  artifact_scope: bool | None = None,
                  blocking_scope: bool | None = None,
-                 jit_scope: bool | None = None) -> list[Finding]:
+                 jit_scope: bool | None = None,
+                 serve_scope: bool | None = None) -> list[Finding]:
     """Lint one file's source.  `artifact_scope` forces R3 on/off,
-    `blocking_scope` forces R4 on/off, `jit_scope` forces R5 on/off
-    (None = derive from `relpath`)."""
+    `blocking_scope` forces R4 on/off, `jit_scope` forces R5 on/off,
+    `serve_scope` forces R6 on/off (None = derive from `relpath`)."""
     findings: list[Finding] = []
     lines = src.splitlines()
 
@@ -227,7 +305,21 @@ def check_source(src: str, relpath: str,
         blocking_scope = _in_dirs(BLOCKING_DIRS)
     if jit_scope is None:
         jit_scope = _in_dirs(JIT_SEAM_DIRS)
+    if serve_scope is None:
+        serve_scope = _in_dirs(SERVE_BLOCKING_DIRS)
     blockers = _blocking_receivers(tree) if blocking_scope else set()
+    r6_keys: set[str] = set()
+    r6_suffixes: set[str] = set()
+    if serve_scope:
+        r6_keys, r6_suffixes = _r6_receivers(tree)
+        for call in _sleep_in_while(tree):
+            if not allowed(call.lineno):
+                findings.append(Finding(
+                    relpath, call.lineno, "R6",
+                    "bare time.sleep inside a while loop in serve/ — a "
+                    "poll loop with no deadline; use Event.wait(timeout) "
+                    "or a bounded Condition.wait so shutdown/overload "
+                    "can interrupt it"))
 
     # enclosing-function map for the R3 allowlist
     func_of: dict[int, str] = {}
@@ -289,6 +381,24 @@ def check_source(src: str, relpath: str,
                     f"untimed blocking .{f.attr}() on a Thread/Queue — "
                     "pass a timeout (the watchdog contract: supervision "
                     "code must never be able to hang forever)"))
+        if serve_scope and isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _R6_METHODS \
+                    and not _r6_bounded(node, f.attr) \
+                    and not allowed(node.lineno):
+                key = _recv_key(f.value)
+                suffix = None
+                if key is None and isinstance(f.value, ast.Attribute):
+                    suffix = f.value.attr  # deep chains: match by suffix
+                elif key is not None:
+                    suffix = key.split(".")[-1]
+                if (key in r6_keys) or (suffix in r6_suffixes):
+                    findings.append(Finding(
+                        relpath, node.lineno, "R6",
+                        f"unbounded blocking .{f.attr}() in serve/ — the "
+                        "overload contract: no handler/worker thread may "
+                        "park forever; pass a timeout (or non-blocking "
+                        "form) and shed/fail fast on expiry"))
         if jit_scope and isinstance(node, ast.Attribute) \
                 and node.attr == "jit" \
                 and isinstance(node.value, ast.Name) \
