@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-overload bench-compile clean reproduce
+.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-overload bench-compile bench-pipeline clean reproduce
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
@@ -64,6 +64,15 @@ bench-overload:
 # must report cache hits and a first step in seconds, not minutes
 bench-compile:
 	python tools/bench_compile.py
+
+# serial-vs-async phase-2 scheduling bench: the same seeded search
+# through the historical scheduler (dispatch trace armed) and the
+# --async-pipeline actor/learner service — dispatch-gap p50/p99,
+# device busy fraction, phase-2 wall + host ask/tell latency headroom
+# in one JSON line (docs/BENCHMARKS.md "Search pipelining").  Honors
+# FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host, exit 3).
+bench-pipeline:
+	python tools/bench_pipeline.py
 
 clean:
 	$(MAKE) -C native clean

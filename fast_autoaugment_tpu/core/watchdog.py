@@ -40,6 +40,7 @@ current EMA) — ``utils/faultinject.py``.
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -48,7 +49,48 @@ from typing import Any, Callable
 from fast_autoaugment_tpu.core.resilience import DispatchHungError
 from fast_autoaugment_tpu.utils.logging import get_logger
 
-__all__ = ["DispatchWatchdog", "resolve_watchdog", "DispatchHungError"]
+__all__ = ["DispatchWatchdog", "resolve_watchdog", "DispatchHungError",
+           "arm_dispatch_serializer", "dispatch_enqueue_guard"]
+
+# ---------------------------------------------------------------------
+# Process-wide device-dispatch ENQUEUE serializer.
+#
+# The virtual-multi-device CPU backend deadlocks when two THREADS
+# enqueue collective programs concurrently: each thread walks the
+# per-device executors in its own interleaving, so device i can see
+# program A before B while device j sees B before A — every
+# participant then waits at a rendezvous the other program's
+# participants never reach (observed live: CollectivePermute
+# participants of two run_ids cross-blocked during an overlapped
+# phase-1 train + 2-actor TTA run; the cross-thread sibling of the
+# PR-4 scalar-collective deadlock, which was single-threaded queue
+# depth).  The async search pipeline ARMS this lock so every compiled
+# program launch in the process (trainer dispatch chunks, eval
+# replays, TTA/audit rounds) enqueues under ONE lock — a consistent
+# global program order on every device queue — while completion stays
+# async: the lock covers the enqueue, never the wait, so the
+# host/device overlap the pipeline exists for is untouched.  Device
+# puts/gets are single-participant and stay unguarded.  Disarmed
+# (the default, and every serial path) this is a no-op context.
+
+_ENQUEUE_LOCK = threading.RLock()
+_ENQUEUE_SERIALIZED = False
+
+
+def arm_dispatch_serializer(on: bool = True) -> None:
+    """Turn cross-thread enqueue serialization on/off (process-wide).
+    ``search_policies`` arms it for async-pipeline runs and disarms it
+    for serial runs, so one process can do both in sequence."""
+    global _ENQUEUE_SERIALIZED
+    _ENQUEUE_SERIALIZED = bool(on)
+
+
+def dispatch_enqueue_guard():
+    """Context manager for ONE compiled-program enqueue: the
+    serializer lock when armed, a no-op otherwise."""
+    if _ENQUEUE_SERIALIZED:
+        return _ENQUEUE_LOCK
+    return contextlib.nullcontext()
 
 logger = get_logger("faa_tpu.watchdog")
 
@@ -80,6 +122,14 @@ class DispatchWatchdog:
     :attr:`fires` aggregates every monitored seam; labels keep their
     own EMA because a train dispatch chunk and a whole-split eval
     replay have very different steady-state walls.
+
+    THREAD-SAFE: the async search pipeline (``search/pipeline.py``)
+    runs one monitored dispatch per actor thread concurrently, plus
+    the overlapped phase-1 trainer thread — every read/write of the
+    shared label state (EMAs, call counts, warm labels, fire count)
+    goes through one internal lock.  :meth:`run` itself holds the lock
+    only around that bookkeeping, never across the monitored wait, so
+    concurrent dispatches still overlap freely.
     """
 
     def __init__(self, mode: str | float = "off", *,
@@ -109,6 +159,9 @@ class DispatchWatchdog:
         # their first call gets the warm allowance, never the blind
         # compile window
         self._warm_labels: set[str] = set()
+        # guards every access to the shared label state above: the
+        # async pipeline dispatches from several actor threads at once
+        self._lock = threading.RLock()
 
     @property
     def enabled(self) -> bool:
@@ -117,21 +170,24 @@ class DispatchWatchdog:
     def ema(self, label: str) -> float | None:
         """Current EMA of observed wall seconds for `label` (None until
         the first completed call)."""
-        return self._ema.get(label)
+        with self._lock:
+            return self._ema.get(label)
 
     def mark_compile_warm(self, label: str) -> None:
         """Declare `label`'s executable pre-compiled (AOT-loaded / known
         persistent-cache hit): its first call gets the bounded
         ``warm_allowance`` instead of the blind compile window."""
-        self._warm_labels.add(label)
+        with self._lock:
+            self._warm_labels.add(label)
 
     def _first_call_warm(self, label: str) -> bool:
         """Whether `label`'s FIRST call should be treated as compile-free:
         explicitly marked warm, or the process has already proven the
         persistent compile cache warm (hits observed, zero misses —
         ``core/compilecache.py``)."""
-        if label in self._warm_labels:
-            return True
+        with self._lock:
+            if label in self._warm_labels:
+                return True
         try:
             from fast_autoaugment_tpu.core import compilecache
         except ImportError:  # pragma: no cover — core package is intact
@@ -149,18 +205,20 @@ class DispatchWatchdog:
         normal deadline floor (``warm_allowance``), so a warm process
         cannot hide a genuine multi-minute hang behind a compile grace
         window it no longer needs."""
-        first = self._calls.get(label, 0) == 0
+        with self._lock:
+            first = self._calls.get(label, 0) == 0
         warm = first and self._first_call_warm(label)
-        if isinstance(self.mode, float):
-            if first and not warm:
-                return max(self.mode, self.compile_allowance)
-            return self.mode
-        # auto: generous compile allowance first, then EMA-derived
-        if first or label not in self._ema:
-            if warm:
-                return max(self.min_deadline, self.warm_allowance)
-            return self.compile_allowance
-        return max(self.min_deadline, self.hang_factor * self._ema[label])
+        with self._lock:
+            if isinstance(self.mode, float):
+                if first and not warm:
+                    return max(self.mode, self.compile_allowance)
+                return self.mode
+            # auto: generous compile allowance first, then EMA-derived
+            if first or label not in self._ema:
+                if warm:
+                    return max(self.min_deadline, self.warm_allowance)
+                return self.compile_allowance
+            return max(self.min_deadline, self.hang_factor * self._ema[label])
 
     def observe(self, label: str, wall_sec: float) -> None:
         """Fold one observed dispatch wall time into the label's EMA.
@@ -168,13 +226,14 @@ class DispatchWatchdog:
         The first observation seeds the EMA directly — it is the
         compile call, but using it only ever makes deadlines MORE
         generous until steady-state observations pull the EMA down."""
-        self._calls[label] = self._calls.get(label, 0) + 1
-        prev = self._ema.get(label)
-        if prev is None:
-            self._ema[label] = float(wall_sec)
-        else:
-            self._ema[label] = (self.ema_alpha * float(wall_sec)
-                                + (1.0 - self.ema_alpha) * prev)
+        with self._lock:
+            self._calls[label] = self._calls.get(label, 0) + 1
+            prev = self._ema.get(label)
+            if prev is None:
+                self._ema[label] = float(wall_sec)
+            else:
+                self._ema[label] = (self.ema_alpha * float(wall_sec)
+                                    + (1.0 - self.ema_alpha) * prev)
 
     def run(self, label: str, fn: Callable, *args: Any,
             inject_delay: float = 0.0) -> Any:
@@ -191,7 +250,9 @@ class DispatchWatchdog:
 
         if not self.enabled:
             _sleep(inject_delay)
-            return jax.block_until_ready(fn(*args))
+            with dispatch_enqueue_guard():
+                out = fn(*args)
+            return jax.block_until_ready(out)
 
         deadline = self.deadline(label)
         out_q: queue.Queue = queue.Queue(maxsize=1)
@@ -200,7 +261,9 @@ class DispatchWatchdog:
         def _worker():
             try:
                 _sleep(inject_delay)
-                out = jax.block_until_ready(fn(*args))
+                with dispatch_enqueue_guard():
+                    out = fn(*args)
+                out = jax.block_until_ready(out)
                 out_q.put(("ok", out, time.monotonic() - t0))
             except BaseException as e:  # delivered to the caller below
                 out_q.put(("err", e, time.monotonic() - t0))
@@ -211,13 +274,15 @@ class DispatchWatchdog:
         try:
             kind, value, wall = out_q.get(timeout=deadline)
         except queue.Empty:
-            self.fires += 1
+            with self._lock:
+                self.fires += 1
+                ema = self._ema.get(label)
             waited = time.monotonic() - t0
             logger.error(
                 "watchdog FIRED on %r: no completion after %.1fs "
                 "(deadline %.1fs, ema %s) — dispatch presumed hung",
                 label, waited, deadline,
-                f"{self._ema[label]:.3f}s" if label in self._ema else "n/a")
+                f"{ema:.3f}s" if ema is not None else "n/a")
             raise DispatchHungError(label, deadline, waited)
         if kind == "err":
             raise value
@@ -229,12 +294,19 @@ class DispatchWatchdog:
         deadlines + EMAs (stamped into bench JSON and
         ``search_result.json`` so hangs and stragglers are
         distinguishable after the fact)."""
+        with self._lock:
+            labels = list(self._calls)
+            ema = dict(self._ema)
+            fires = self.fires
+            warm = sorted(self._warm_labels)
         return {
             "mode": self.mode if isinstance(self.mode, str) else float(self.mode),
-            "fires": self.fires,
-            "deadline_sec": {lb: self.deadline(lb) for lb in self._calls},
-            "ema_sec": {lb: round(v, 6) for lb, v in self._ema.items()},
-            "warm_labels": sorted(self._warm_labels),
+            "fires": fires,
+            # deadline() re-locks per label: a concurrent observe
+            # between snapshots only ever yields a FRESHER deadline
+            "deadline_sec": {lb: self.deadline(lb) for lb in labels},
+            "ema_sec": {lb: round(v, 6) for lb, v in ema.items()},
+            "warm_labels": warm,
         }
 
 

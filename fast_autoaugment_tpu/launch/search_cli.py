@@ -119,6 +119,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-op", type=int, default=2)
     p.add_argument("--num-search", type=int, default=200)
     p.add_argument("--num-top", type=int, default=10)
+    p.add_argument("--async-pipeline", default="off", choices=("off", "on"),
+                   help="streaming actor/learner phase-2 scheduler "
+                        "(search/pipeline.py): device actor threads pull "
+                        "ready-built candidate rounds from a bounded "
+                        "queue while the TPE learner digests completed "
+                        "results and refills proposals concurrently "
+                        "(tells apply in trial-id order, so the schedule "
+                        "is deterministic), and phase-2 trials on fold k "
+                        "start the moment fold k's phase-1 gate clears "
+                        "while later folds still train.  'off' (default) "
+                        "= the historical serial driver bit-for-bit; "
+                        "'on' with --pipeline-actors 1 --pipeline-queue-"
+                        "depth 0 reproduces the serial trial log exactly "
+                        "(docs/BENCHMARKS.md 'Search pipelining')")
+    p.add_argument("--pipeline-actors", type=int, default=1,
+                   help="device actor threads per fold in --async-"
+                        "pipeline on (each runs one monitored TTA "
+                        "dispatch at a time against the shared compiled "
+                        "step)")
+    p.add_argument("--pipeline-queue-depth", type=int, default=1,
+                   help="candidate rounds proposed AHEAD of the actors "
+                        "in --async-pipeline on (the in-flight window is "
+                        "actors + depth rounds; pending rounds contribute "
+                        "constant-liar placeholders to the posterior).  "
+                        "0 = lockstep ask-after-tell")
     p.add_argument("--trial-batch", type=int, default=1,
                    help="K concurrent TPE trials per fold, evaluated by ONE "
                         "vmapped TTA program per batch (constant-liar "
@@ -357,6 +382,9 @@ def _run(args, conf, t_start):
         watchdog=args.watchdog,
         work_queue=work_queue,
         compile_cache=args.compile_cache,
+        async_pipeline=args.async_pipeline,
+        pipeline_actors=args.pipeline_actors,
+        pipeline_queue_depth=args.pipeline_queue_depth,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []

@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
 from typing import Callable
 
@@ -256,7 +257,7 @@ class _FoldEval:
 
     def __init__(self, conf, dataroot, mesh, *, num_policy, num_op, cv_ratio,
                  seed, trial_batch: int = 1, aug_dispatch: str = "exact",
-                 aug_groups: int = 8, watchdog=None):
+                 aug_groups: int = 8, watchdog=None, trace=None):
         from fast_autoaugment_tpu.ops.augment import check_aug_dispatch
 
         self.conf, self.dataroot, self.mesh = conf, dataroot, mesh
@@ -266,7 +267,14 @@ class _FoldEval:
         self.aug_dispatch = check_aug_dispatch(aug_dispatch)
         self.aug_groups = max(1, int(aug_groups))
         self.watchdog = resolve_watchdog(watchdog)
+        # optional DispatchTrace (search/pipeline.py): per-dispatch
+        # start/end timestamps for the dispatch-gap evidence
+        self.trace = trace
         self._built = False
+        # the async pipeline evaluates from several actor threads (and
+        # the overlapped phase-1 gate from the trainer thread): build
+        # and per-fold batch-cache population are lock-guarded
+        self._lock = threading.RLock()
         self._batches: dict[int, Callable] = {}
         # distinct leading policy-tensor shapes fed to the compiled TTA
         # step; the executable-count invariant is exactly one compile
@@ -278,6 +286,10 @@ class _FoldEval:
         self.batch_policy_shapes: set[int] = set()
 
     def _build(self):
+        with self._lock:
+            self._build_locked()
+
+    def _build_locked(self):
         if self._built:
             return
         conf, mesh = self.conf, self.mesh
@@ -360,6 +372,10 @@ class _FoldEval:
         the policy tensor does); lazy on-disk datasets (ImageNet) stream
         through a prefetch worker."""
         self._build()
+        with self._lock:
+            return self._batches_locked(fold)
+
+    def _batches_locked(self, fold: int) -> Callable:
         if fold in self._batches:
             return self._batches[fold]
         from fast_autoaugment_tpu.data.pipeline import BatchIterator
@@ -403,12 +419,15 @@ class _FoldEval:
             return fn(*args)
         return self.watchdog.run(label, fn, *args)
 
+    def _trace_cb(self):
+        return self.trace.record if self.trace is not None else None
+
     def evaluate(self, fold: int, params, batch_stats, policy_t, key) -> dict:
         self.policy_shapes.add(int(policy_t.shape[0]))
         return self._guarded(
             "tta", eval_tta,
             self.tta_step, params, batch_stats, self.batches_fn(fold)(),
-            policy_t, key,
+            policy_t, key, self._trace_cb(),
         )
 
     def evaluate_batch(self, fold: int, params, batch_stats, policies_t,
@@ -428,15 +447,24 @@ class _FoldEval:
         return self._guarded(
             "tta_batched", eval_tta_batched,
             self.tta_step_batch, params, batch_stats,
-            self.batches_fn(fold)(), policies_t, keys,
+            self.batches_fn(fold)(), policies_t, keys, self._trace_cb(),
         )
 
     def audit_eval(self, params, batch_stats, batch, subs, key) -> dict:
         """Batched audit: S sub-policies against one mesh-placed batch
         in a single compiled call (``make_audit_step``)."""
+        from fast_autoaugment_tpu.core.watchdog import (
+            dispatch_enqueue_guard,
+        )
+
         self._build()
+
+        def _dispatch(*args):  # serialized enqueue (async pipeline only)
+            with dispatch_enqueue_guard():
+                return self.audit_step(*args)
+
         return self._guarded(
-            "audit", self.audit_step, params, batch_stats, batch["x"],
+            "audit", _dispatch, params, batch_stats, batch["x"],
             batch["y"], batch["m"], subs, key)
 
     def baseline(self, fold: int, path: str) -> float:
@@ -485,6 +513,9 @@ def search_policies(
     watchdog="off",
     work_queue=None,
     compile_cache: str = "off",
+    async_pipeline: str | bool = "off",
+    pipeline_actors: int = 1,
+    pipeline_queue_depth: int = 1,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -595,6 +626,31 @@ def search_policies(
     ``lost_hosts``, ``reclaimed_units``) is stamped into the result.
     Fold stacking is forced off (work units are per fold).
 
+    `async_pipeline` ("off" default / "on") restructures the search as
+    the streaming actor/learner pipeline (``search/pipeline.py``, the
+    Podracer decomposition, arXiv:2104.06272): device ACTOR threads
+    (`pipeline_actors`) pull ready-built candidate rounds from a
+    bounded queue (`pipeline_queue_depth` rounds proposed ahead) and
+    run the usual ``_FoldEval`` TTA dispatches, while the TPE LEARNER
+    digests completed rounds and refills proposals concurrently through
+    the proposal ledger (``tpe.ask_tagged``/``tell(trial_id, ...)`` —
+    out-of-order completions apply in canonical trial-id order, so the
+    whole schedule is deterministic given the geometry).  On top, a
+    PHASE-OVERLAP scheduler starts fold k's phase-2 trials the moment
+    fold k's phase-1 training and quality gate complete, while the
+    remaining folds still train (the single-host MPMD pipeline seed,
+    arXiv:2412.14374).  "off" (default) is bit-for-bit the historical
+    serial driver; "on" with ``pipeline_actors=1, pipeline_queue_depth
+    =0`` reproduces the serial trial log exactly (in-flight window of
+    one round = no constant-liar horizon), and deeper geometries
+    deviate only the way a larger `trial_batch` does — pessimistic
+    placeholder posteriors for in-flight rounds.  Accounting lands in
+    ``search_result.json['pipeline']`` (mode, actors, queue_depth,
+    tell_reorders, device_busy_frac + the dispatch-gap histogram) —
+    ``tools/bench_pipeline.py`` / ``make bench-pipeline`` is the
+    measured serial-vs-async evidence.  Async mode is single-host:
+    `work_queue` forces it off (work units already scatter folds).
+
     `compile_cache` ("off" default / a directory) wires JAX's
     persistent compilation cache through every compile this search
     pays — phase-1 training, TTA, audit, retrains — so a fresh process
@@ -675,11 +731,38 @@ def search_policies(
     trial_batch = max(1, int(trial_batch))
     result["trial_batch"] = trial_batch
     wd = resolve_watchdog(watchdog)
+    # async actor/learner pipeline (search/pipeline.py): resolved here
+    # so a typo fails loudly before any training; the dispatch trace is
+    # armed for async runs and (FAA_PIPELINE_TRACE=1) serial baselines
+    # so the pipeline bench can compare gap histograms
+    from fast_autoaugment_tpu.search.pipeline import (
+        DispatchTrace,
+        resolve_async_pipeline,
+    )
+
+    pipeline_on = resolve_async_pipeline(async_pipeline)
+    pipeline_actors = max(1, int(pipeline_actors))
+    pipeline_queue_depth = max(0, int(pipeline_queue_depth))
+    if pipeline_on and work_queue is not None:
+        logger.warning("workqueue: async pipeline forced off — the lease "
+                       "queue already scatters folds across hosts")
+        pipeline_on = False
+    # async mode dispatches compiled programs from several threads:
+    # serialize their ENQUEUE so every device queue sees one global
+    # program order (the cross-thread collective rendezvous deadlock —
+    # core/watchdog.py docstring).  Explicitly disarmed for serial runs
+    # so one process can alternate modes.
+    from fast_autoaugment_tpu.core.watchdog import arm_dispatch_serializer
+
+    arm_dispatch_serializer(pipeline_on)
+    trace = None
+    if pipeline_on or os.environ.get("FAA_PIPELINE_TRACE"):
+        trace = DispatchTrace()
     evaluator = _FoldEval(
         conf, dataroot, mesh,
         num_policy=num_policy, num_op=num_op, cv_ratio=cv_ratio, seed=seed,
         trial_batch=trial_batch, aug_dispatch=aug_dispatch,
-        aug_groups=aug_groups, watchdog=wd,
+        aug_groups=aug_groups, watchdog=wd, trace=trace,
     )
     # dispatch-mode stamping: the artifact must say which augmentation
     # kernel scored these trials (grouped deviates distributionally)
@@ -914,10 +997,23 @@ def search_policies(
                 progress = True
             if pending and not progress:
                 work_queue.beat_host()
-                time.sleep(max(0.2, min(5.0, work_queue.lease_ttl / 4.0)))
+                # TTL-bounded claim poll: the loop's exit is queue
+                # completion by ANY host, and each wait is capped well
+                # under the lease TTL so reclaims are never starved
+                time.sleep(max(0.2, min(5.0, work_queue.lease_ttl / 4.0)))  # robust: allow
         work_queue.beat_host()
 
-    if work_queue is None:
+    # phase overlap (async pipeline): phase-1 fold training moves onto
+    # a trainer thread inside the phase-2 section below — fold k's TPE
+    # trials start the moment its gate clears, while fold k+1 still
+    # trains.  Stacked groups (if any) already trained above, in the
+    # main thread; the overlapped per-fold body then only runs gates.
+    overlap_mode = pipeline_on and work_queue is None and until >= 2
+    if overlap_mode:
+        logger.info(
+            "async pipeline: overlapping phase-1 fold training with "
+            "phase-2 search (each fold hands over at gate completion)")
+    elif work_queue is None:
         for fold in range(cv_num):
             if fold not in fold_list:
                 continue
@@ -940,19 +1036,28 @@ def search_policies(
                 fold_baselines[fold] = float(info["baseline"])
             if info.get("excluded") and fold not in excluded_folds:
                 excluded_folds.append(fold)
-    # device_secs_* is the honest name; tpu_secs_* stays as a
-    # compatibility alias for committed-artifact readers (same value)
-    result["device_secs_phase1"] = result["tpu_secs_phase1"] = (
-        (time.time() - t0) * mesh.size)
-    # per-fold attribution of the phase total: training wall x devices
-    # credited per fold (stacked groups record ONE wall measurement and
-    # split it evenly — the phase total is never double-counted); the
-    # gap between sum(per_fold) and device_secs_phase1 is the gate's
-    # baseline evals plus setup, which belong to no single fold
-    result["device_secs_phase1_per_fold"] = {
-        str(f): phase1_attr[f] for f in sorted(phase1_attr)}
-    result["fold_baselines"] = {str(k): v for k, v in fold_baselines.items()}
-    result["excluded_folds"] = list(excluded_folds)
+    phase1_t0 = t0
+
+    def _stamp_phase1(end_time: float | None = None):
+        # device_secs_* is the honest name; tpu_secs_* stays as a
+        # compatibility alias for committed-artifact readers (same value)
+        end = time.time() if end_time is None else end_time
+        result["device_secs_phase1"] = result["tpu_secs_phase1"] = (
+            (end - phase1_t0) * mesh.size)
+        # per-fold attribution of the phase total: training wall x
+        # devices credited per fold (stacked groups record ONE wall
+        # measurement and split it evenly — the phase total is never
+        # double-counted); the gap between sum(per_fold) and
+        # device_secs_phase1 is the gate's baseline evals plus setup,
+        # which belong to no single fold
+        result["device_secs_phase1_per_fold"] = {
+            str(f): phase1_attr[f] for f in sorted(phase1_attr)}
+        result["fold_baselines"] = {
+            str(k): v for k, v in fold_baselines.items()}
+        result["excluded_folds"] = list(excluded_folds)
+
+    if not overlap_mode:  # overlap re-stamps after the trainer finishes
+        _stamp_phase1()
     if until < 2:
         result["final_policy_set"] = []
         result["compile_cache"] = compile_cache_stats()
@@ -963,6 +1068,78 @@ def search_policies(
     t0 = time.time()
     space = make_search_space(num_policy, num_op)
     final_policy_set = []
+    # async-pipeline accounting + the cross-thread stop channel: the
+    # overlapped trainer pushes its failure here so the in-flight
+    # learner stops at the next round boundary instead of finishing
+    # the fold against a dying run
+    pipeline_fold_stats: list[dict] = []
+    pipeline_stop_cell: list[BaseException] = []
+    pipeline_overlap_timeline: dict = {}
+
+    def _pipeline_should_stop():
+        return pipeline_stop_cell[0] if pipeline_stop_cell else None
+
+    def _phase2_fold_async(fold, params, batch_stats, tpe, key_fold,
+                           fold_trials, heartbeat=None) -> dict:
+        """One fold's trial budget through the actor/learner pipeline
+        (``search/pipeline.py``).  Persistence, quarantine and census
+        bookkeeping mirror the serial schedulers; the trial log is
+        appended in trial-id order so the artifact stream is
+        schedule-invariant."""
+        from fast_autoaugment_tpu.search.pipeline import (
+            replay_trial_log,
+            run_fold_pipeline,
+        )
+
+        replay_trial_log(
+            tpe, fold_trials, trial_batch, num_search,
+            max_inflight=pipeline_actors + pipeline_queue_depth)
+
+        def _persist():
+            trials_log[str(fold)] = fold_trials
+            if work_queue is not None:
+                _write_json_atomic(_fold_trials_path(fold), fold_trials)
+            else:
+                _write_json_atomic(trials_path, trials_log)
+
+        def _record_quarantine(lo, hi, exc, worst):
+            logger.warning(
+                "phase2 fold %d trial(s) %d-%d: TTA evaluation FAILED "
+                "(%s: %s) — QUARANTINED with worst-observed reward %.4f; "
+                "the search continues", fold, lo, hi - 1,
+                type(exc).__name__, exc, worst)
+            for t in range(lo, hi):
+                quarantined.append({
+                    "fold": fold, "trial": t,
+                    "error": f"{type(exc).__name__}: {exc}"})
+
+        def _on_first_ok():
+            if trial_batch > 1:
+                if "tta_batched_executables_first" not in result:
+                    result["tta_batched_executables_first"] = (
+                        executable_census(evaluator.tta_step_batch))
+            elif "tta_executables_first" not in result:
+                result["tta_executables_first"] = executable_census(
+                    evaluator.tta_step)
+
+        if trace is not None:
+            trace.begin_segment(f"p2-fold{fold}")
+        try:
+            stats = run_fold_pipeline(
+                evaluator, fold, params, batch_stats, tpe, key_fold,
+                fold_trials,
+                num_search=num_search, trial_batch=trial_batch,
+                actors=pipeline_actors, queue_depth=pipeline_queue_depth,
+                num_policy=num_policy, num_op=num_op,
+                persist=_persist, record_quarantine=_record_quarantine,
+                on_first_ok=_on_first_ok,
+                should_stop=_pipeline_should_stop, heartbeat=heartbeat,
+            )
+        finally:
+            if trace is not None:
+                trace.end_segment()
+        pipeline_fold_stats.append(dict(stats, fold=fold))
+        return {"num_trials": len(fold_trials)}
 
     def _phase2_fold(fold: int, heartbeat=None) -> dict | None:
         """One fold's full TPE trial budget (sequential or batched
@@ -984,6 +1161,11 @@ def search_policies(
                   n_startup=min(20, max(5, num_search // 4)))
         key_fold = jax.random.PRNGKey(seed * 77 + fold)
         fold_trials = _load_fold_trials(fold)
+        if pipeline_on:
+            # async actor/learner scheduler: resume replay goes through
+            # the proposal ledger (exact ask/tell interleaving) inside
+            return _phase2_fold_async(fold, params, batch_stats, tpe,
+                                      key_fold, fold_trials, heartbeat)
         for entry in fold_trials:  # resume previous trials (a third
             # element marks a quarantined trial's failure record)
             tpe.tell(entry[0], entry[1])
@@ -1027,6 +1209,8 @@ def search_policies(
                 raise RuntimeError(
                     f"injected trial_error at trial {trial_idx}")
 
+        if trace is not None:  # serial dispatch-gap baseline
+            trace.begin_segment(f"p2-fold{fold}")
         while trial_batch <= 1 and len(tpe.observations) < num_search:
             trial_idx = len(tpe.observations)
             proposal = tpe.suggest()
@@ -1128,9 +1312,30 @@ def search_policies(
                 fold, t_base, t_base + k_eff - 1, num_search, k_eff,
                 max(rewards), tpe.best[1],
             )
+        if trace is not None:
+            trace.end_segment()
         return {"num_trials": len(fold_trials)}
 
-    if work_queue is None:
+    if overlap_mode:
+        from fast_autoaugment_tpu.search.pipeline import (
+            run_overlapped_phases,
+        )
+
+        def _p1_overlap(f):
+            try:
+                _phase1_fold(f)
+            except BaseException as e:
+                # the in-flight learner must stop at its next round
+                # boundary, not finish the fold against a dying run
+                pipeline_stop_cell.append(e)
+                raise
+
+        timeline = run_overlapped_phases(fold_list, _p1_overlap,
+                                         _phase2_fold)
+        pipeline_overlap_timeline.update(timeline)
+        p1_ends = [v["end"] for v in timeline["phase1"].values()]
+        _stamp_phase1(max(p1_ends) if p1_ends else None)
+    elif work_queue is None:
         for fold in fold_list:
             _phase2_fold(fold)
     else:
@@ -1196,6 +1401,27 @@ def search_policies(
             len(quarantined))
     result["device_secs_phase2"] = result["tpu_secs_phase2"] = (
         (time.time() - t0) * mesh.size)
+    # async-pipeline accounting (+ the dispatch-gap evidence whenever
+    # the trace is armed — FAA_PIPELINE_TRACE=1 captures the serial
+    # baseline the pipeline bench compares against).  In overlap mode
+    # device_secs_phase2 spans the whole overlapped region; the
+    # timeline below carries the per-fold interleaving.
+    if pipeline_on or trace is not None:
+        gaps = trace.summary() if trace is not None else None
+        result["pipeline"] = {
+            "mode": "on" if pipeline_on else "off",
+            "actors": pipeline_actors if pipeline_on else None,
+            "queue_depth": pipeline_queue_depth if pipeline_on else None,
+            "max_inflight": (pipeline_actors + pipeline_queue_depth
+                             if pipeline_on else None),
+            "tell_reorders": sum(
+                s["tell_reorders"] for s in pipeline_fold_stats),
+            "rounds": sum(s["rounds"] for s in pipeline_fold_stats),
+            "per_fold": pipeline_fold_stats,
+            "device_busy_frac": (gaps or {}).get("device_busy_frac"),
+            "dispatch_gaps": gaps,
+            "overlap": pipeline_overlap_timeline or None,
+        }
     # compile-cache census: the whole point of policy-as-tensor TTA is
     # that EVERY trial reuses one executable (SURVEY.md hard-part 3) —
     # record it so the search-cost artifact can assert zero recompiles

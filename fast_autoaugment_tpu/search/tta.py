@@ -31,6 +31,7 @@ import numpy as np
 
 from fast_autoaugment_tpu.core.compilecache import seam_jit
 from fast_autoaugment_tpu.core.metrics import Accumulator
+from fast_autoaugment_tpu.core.watchdog import dispatch_enqueue_guard
 from fast_autoaugment_tpu.ops.preprocess import cifar_train_batch
 
 __all__ = ["make_tta_step", "make_audit_step", "eval_tta", "eval_tta_batched"]
@@ -248,7 +249,8 @@ def make_audit_step(model, *, num_policy: int = 5, cutout_length: int = 16,
     return _jit_with_trace_counter(audit_step, "audit")
 
 
-def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
+def eval_tta(tta_step, params, batch_stats, batches, policy, key,
+             trace=None) -> dict:
     """Run the TTA step over a fold's batches; returns
     {'minus_loss', 'top1_valid'} normalized by sample count
     (reference ``search.py:117-133``).
@@ -258,13 +260,26 @@ def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
     this shape) — the driver uploads each fold ONCE and replays the
     device-resident batches across all trials (the fold data is
     identical for every TPE sample; only the policy tensor changes),
-    or streams them through a prefetch worker for lazy datasets."""
+    or streams them through a prefetch worker for lazy datasets.
+
+    `trace(t0, t1)` (optional) receives each dispatch's start/end
+    monotonic timestamps — the per-dispatch evidence behind the
+    pipeline bench's gap histogram.  Tracing forces a per-batch
+    ``block_until_ready`` (the tiny output scalars are pulled to the
+    host right after anyway), so it never changes values."""
+    import time as _time
+
     acc = Accumulator()
     for i, batch in enumerate(batches):
-        out = tta_step(
-            params, batch_stats, batch["x"], batch["y"], batch["m"], policy,
-            jax.random.fold_in(key, i),
-        )
+        t0 = _time.monotonic() if trace is not None else 0.0
+        with dispatch_enqueue_guard():  # async pipeline: one enqueue
+            out = tta_step(             # order on every device queue
+                params, batch_stats, batch["x"], batch["y"], batch["m"],
+                policy, jax.random.fold_in(key, i),
+            )
+        if trace is not None:
+            out = jax.block_until_ready(out)
+            trace(t0, _time.monotonic())
         acc.add_dict(out)
     cnt = acc["cnt"]
     return {
@@ -276,7 +291,7 @@ def eval_tta(tta_step, params, batch_stats, batches, policy, key) -> dict:
 
 
 def eval_tta_batched(tta_step_k, params, batch_stats, batches, policies,
-                     keys) -> list[dict]:
+                     keys, trace=None) -> list[dict]:
     """Batched counterpart of :func:`eval_tta`: K candidate policies
     through a ``make_tta_step(num_candidates=K)`` step in one device
     program per batch.
@@ -287,18 +302,27 @@ def eval_tta_batched(tta_step_k, params, batch_stats, batches, policies,
     :func:`eval_tta` call with ``key=keys[k]`` derives — so each entry
     of the returned list is numerically identical to evaluating that
     candidate alone.  One host sync per batch serves all K candidates
-    (the sequential loop pays it K times)."""
+    (the sequential loop pays it K times).  `trace(t0, t1)` (optional)
+    records each dispatch's start/end monotonic timestamps (the
+    per-batch host sync already bounds the dispatch, so tracing adds
+    two clock reads and nothing else)."""
+    import time as _time
+
     sums: dict[str, np.ndarray] | None = None
     for i, batch in enumerate(batches):
+        t0 = _time.monotonic() if trace is not None else 0.0
         batch_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, i))(keys)
-        out = tta_step_k(
-            params, batch_stats, batch["x"], batch["y"], batch["m"],
-            policies, batch_keys,
-        )
+        with dispatch_enqueue_guard():
+            out = tta_step_k(
+                params, batch_stats, batch["x"], batch["y"], batch["m"],
+                policies, batch_keys,
+            )
         # accumulate at native f32 on the host: the same sequential
         # f32 additions eval_tta's Accumulator performs on device, so
         # batched == sequential holds bit-for-bit across batches too
         out = {k: np.asarray(v) for k, v in out.items()}
+        if trace is not None:
+            trace(t0, _time.monotonic())
         sums = out if sums is None else {
             k: sums[k] + out[k] for k in sums
         }

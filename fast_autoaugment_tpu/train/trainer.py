@@ -42,7 +42,10 @@ from fast_autoaugment_tpu.core.resilience import (
     install_signal_handlers,
     preemption_requested,
 )
-from fast_autoaugment_tpu.core.watchdog import resolve_watchdog
+from fast_autoaugment_tpu.core.watchdog import (
+    dispatch_enqueue_guard,
+    resolve_watchdog,
+)
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.data.pipeline import (
     BatchIterator,
@@ -161,7 +164,9 @@ def _run_replay_eval(replay_step, params, batch_stats, groups,
             out = wd.run("replay_eval", replay_step, params, batch_stats,
                          g["x"], g["y"], g["m"])
         else:
-            out = replay_step(params, batch_stats, g["x"], g["y"], g["m"])
+            with dispatch_enqueue_guard():
+                out = replay_step(params, batch_stats, g["x"], g["y"],
+                                  g["m"])
         acc.add_dict(out)
     return acc.normalize()
 
@@ -179,7 +184,10 @@ def _monitored_dispatch(wd, label: str, fi, step: int, fn, *args):
     recovery — core/watchdog.py)."""
     inject = fi.dispatch_delay(step) if fi is not None else None
     if inject is None and not wd.enabled:
-        return fn(*args)
+        # enqueue-order serialization (async pipeline only; no-op
+        # otherwise) — completion stays async, the historical path
+        with dispatch_enqueue_guard():
+            return fn(*args)
     delay = 0.0
     if inject is not None:
         kind, val = inject

@@ -257,6 +257,69 @@ def test_r6_robust_allow_suppression():
     assert not check_source(src, SERVE_SCOPE)
 
 
+# ------------------------------------------------------------------ R7
+# the R6 rule set extended to search/ scope: the async actor/learner
+# pipeline threads dispatches under the same no-thread-parks-forever
+# contract as serving (ISSUE 9; search/pipeline.py is gated from day one)
+
+SEARCH_SCOPE = "fast_autoaugment_tpu/search/pipeline.py"
+
+
+def test_r7_unbounded_queue_put_flagged_in_search():
+    src = "import queue\nq = queue.Queue()\nq.put(item)\n"
+    assert _rules(check_source(src, SEARCH_SCOPE)) == ["R7"]
+    assert not check_source(
+        src.replace("q.put(item)", "q.put(item, timeout=60.0)"),
+        SEARCH_SCOPE)
+
+
+def test_r7_event_and_condition_wait_flagged():
+    src = ("import threading\n"
+           "evt = threading.Event()\ncond = threading.Condition()\n"
+           "evt.wait()\ncond.wait()\n")
+    assert _rules(check_source(src, SEARCH_SCOPE)) == ["R7", "R7"]
+    timed = src.replace("evt.wait()", "evt.wait(0.5)").replace(
+        "cond.wait()", "cond.wait(timeout=0.5)")
+    assert not check_source(timed, SEARCH_SCOPE)
+
+
+def test_r7_untimed_join_get_flagged_alongside_r4():
+    """search/ sits in BOTH the R4 supervision scope and the R7
+    pipeline scope: an untimed join/get on a constructor-tracked
+    receiver trips both rules (same fix clears both)."""
+    src = ("import threading, queue\n"
+           "t = threading.Thread(target=f)\nq = queue.Queue()\n"
+           "t.join()\nq.get()\n")
+    rules = _rules(check_source(src, SEARCH_SCOPE))
+    assert rules.count("R7") == 2
+    assert rules.count("R4") == 2
+    timed = src.replace("t.join()", "t.join(timeout=5)").replace(
+        "q.get()", "q.get(timeout=0.2)")
+    assert not check_source(timed, SEARCH_SCOPE)
+
+
+def test_r7_bare_sleep_loop_flagged():
+    src = "import time\nwhile not done():\n    time.sleep(0.5)\n"
+    assert _rules(check_source(src, SEARCH_SCOPE)) == ["R7"]
+    assert not check_source("import time\ntime.sleep(0.5)\n", SEARCH_SCOPE)
+
+
+def test_r7_out_of_scope_dirs_not_flagged():
+    src = ("import queue, time\nq = queue.Queue()\nq.put(item)\n"
+           "while True:\n    time.sleep(0.1)\n")
+    for scope in (OUT_SCOPE, TRAIN_SCOPE):
+        assert "R7" not in _rules(check_source(src, scope)), scope
+    # serve/ keeps its own rule id for the same engine
+    assert "R7" not in _rules(check_source(src, SERVE_SCOPE))
+    assert "R6" in _rules(check_source(src, SERVE_SCOPE))
+
+
+def test_r7_robust_allow_suppression():
+    src = ("import time\nwhile pending:\n"
+           "    time.sleep(1.0)  # robust: allow — TTL-bounded poll\n")
+    assert "R7" not in _rules(check_source(src, SEARCH_SCOPE))
+
+
 def test_repo_is_clean():
     """The live gate: the package must hold the discipline the
     resilience subsystem depends on (make lint-robust)."""

@@ -114,6 +114,48 @@ def test_stats_shape():
     assert "d" in s["deadline_sec"] and "d" in s["ema_sec"]
 
 
+def test_label_state_thread_safe_under_hammer():
+    """ISSUE 9 satellite: stats()/mark_compile_warm()/observe()/
+    deadline() mutate shared dicts — one monitored dispatch per async
+    actor means they now run concurrently.  Hammer all four from
+    threads; every call must survive (no RuntimeError from a dict
+    changing size mid-iteration, the pre-lock failure mode) and the
+    final state must account for every write."""
+    import threading
+
+    wd = DispatchWatchdog("auto")
+    n_threads, n_iter = 8, 300
+    errors: list[BaseException] = []
+    start = threading.Barrier(n_threads)
+
+    def hammer(idx: int):
+        try:
+            start.wait(timeout=10)
+            for i in range(n_iter):
+                label = f"lab{idx}-{i % 7}"
+                wd.observe(label, 0.01 * (i + 1))
+                wd.mark_compile_warm(f"warm{idx}-{i % 5}")
+                wd.deadline(label)
+                s = wd.stats()
+                assert s["fires"] == 0
+        except BaseException as e:  # surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    s = wd.stats()
+    # every (thread, label) stream folded in: 7 labels per thread, and
+    # each label observed ceil/floor(n_iter/7) times
+    assert len(s["ema_sec"]) == n_threads * 7
+    assert len(s["warm_labels"]) == n_threads * 5
+    assert sum(wd._calls.values()) == n_threads * n_iter
+
+
 # ------------------------------------------------- resolve_watchdog
 
 def test_resolve_watchdog_specs():

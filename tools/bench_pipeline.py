@@ -1,0 +1,203 @@
+"""Serial vs async phase-2 scheduling: dispatch-gap histograms + wall.
+
+The async actor/learner pipeline (``search/pipeline.py``,
+``--async-pipeline on``) exists to drive the idle time BETWEEN device
+dispatches to ~0: in the serial scheduler every round pays host-side
+TPE math (``tools/bench_tpe.py`` measures ~3-5 ms/trial on the real
+30-D space), policy decode + tensor upload, and an fsync'd trial-log
+persist while the device waits.  This bench runs the SAME seeded search
+twice — serial (``FAA_PIPELINE_TRACE=1`` arms the dispatch trace on the
+historical scheduler) and async — and reports, per arm:
+
+- the dispatch-gap histogram (p50/p99 inter-dispatch idle, log-bucket
+  counts) and the device busy fraction during phase 2,
+- end-to-end ``search_secs`` (phase-2 wall) and the async speedup,
+- the host ask/tell latency rows for the configured trial batch (the
+  overlap headroom the pipeline hides), and
+- contention + compile-cache stamps (every number on this host is a
+  1-core CPU plumbing number; the cache keeps the first dispatch from
+  reading as a 7 s "busy" window in both arms).
+
+Phase 1 is trained once in a warmup run and its fold checkpoint is
+copied into both arms' save dirs, so the comparison is pure phase-2
+scheduling.  Honors ``FAA_BENCH_REQUIRE_QUIET=1`` (refuses on a
+contended host, exit 3).
+
+    python tools/bench_pipeline.py --num-search 32 --trial-batch 4
+    make bench-pipeline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _conf(batch: int, epoch: int):
+    from fast_autoaugment_tpu.core.config import Config
+
+    return Config({
+        "model": {"type": "wresnet10_1"},
+        "dataset": "synthetic",
+        "aug": "default",
+        "cutout": 8,
+        "batch": batch,
+        "epoch": epoch,
+        "lr": 0.05,
+        "lr_schedule": {"type": "cosine"},
+        "optimizer": {"type": "sgd", "decay": 1e-4, "clip": 5.0,
+                      "momentum": 0.9, "nesterov": True},
+    })
+
+
+_CKPT_COPY_SUFFIXES = ("", ".meta.json")
+
+
+def _copy_fold_ckpt(src_dir: str, dst_dir: str, name: str) -> None:
+    os.makedirs(dst_dir, exist_ok=True)
+    for suffix in _CKPT_COPY_SUFFIXES:
+        src = os.path.join(src_dir, name + suffix)
+        if os.path.exists(src):
+            shutil.copy2(src, os.path.join(dst_dir, name + suffix))
+
+
+def run_pipeline_bench(args, workdir: str) -> dict:
+    import jax
+
+    from fast_autoaugment_tpu.search.driver import (
+        _fold_ckpt_path,
+        search_policies,
+    )
+
+    conf = _conf(args.batch, 1)
+    cache_dir = os.path.join(workdir, "compile_cache")
+    common = dict(
+        dataroot=workdir, cv_num=1, cv_ratio=args.cv_ratio,
+        num_policy=args.num_policy, num_op=args.num_op,
+        num_top=5, trial_batch=args.trial_batch, seed=args.seed,
+        compile_cache=cache_dir,
+    )
+    devices = jax.device_count()
+
+    # warmup: train the shared phase-1 fold + fill the compile cache
+    # (one round of trials compiles the TTA step into the cache, so
+    # neither measured arm's first dispatch is a compile window)
+    warm_dir = os.path.join(workdir, "warm")
+    search_policies(conf, save_dir=warm_dir,
+                    num_search=max(1, args.trial_batch), **common)
+    ckpt_name = os.path.basename(_fold_ckpt_path(warm_dir, conf, 0,
+                                                 args.cv_ratio))
+
+    def _one_arm(tag: str, async_on: bool) -> dict:
+        save_dir = os.path.join(workdir, tag)
+        _copy_fold_ckpt(warm_dir, save_dir, ckpt_name)
+        if not async_on:
+            os.environ["FAA_PIPELINE_TRACE"] = "1"
+        try:
+            t0 = time.time()
+            result = search_policies(
+                conf, save_dir=save_dir, num_search=args.num_search,
+                async_pipeline="on" if async_on else "off",
+                pipeline_actors=args.actors,
+                pipeline_queue_depth=args.queue_depth, **common)
+            wall = time.time() - t0
+        finally:
+            os.environ.pop("FAA_PIPELINE_TRACE", None)
+        pipe = result.get("pipeline") or {}
+        return {
+            "mode": "async" if async_on else "serial",
+            "actors": args.actors if async_on else None,
+            "queue_depth": args.queue_depth if async_on else None,
+            "search_secs": round(wall, 3),
+            "phase2_secs": round(
+                result["device_secs_phase2"] / max(1, devices), 3),
+            "device_busy_frac": pipe.get("device_busy_frac"),
+            "dispatch_gaps": pipe.get("dispatch_gaps"),
+            "tell_reorders": pipe.get("tell_reorders"),
+            "num_sub_policies": result.get("num_sub_policies"),
+            "compile_cache": result.get("compile_cache"),
+        }
+
+    serial = _one_arm("serial", False)
+    async_ = _one_arm("async", True)
+    speedup = (serial["phase2_secs"] / async_["phase2_secs"]
+               if async_["phase2_secs"] else None)
+    return {
+        "bench": "pipeline",
+        "devices": devices,
+        "num_search": args.num_search,
+        "trial_batch": args.trial_batch,
+        "num_policy": args.num_policy,
+        "num_op": args.num_op,
+        "serial": serial,
+        "async": async_,
+        "phase2_speedup": round(speedup, 3) if speedup else None,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-search", type=int, default=24)
+    p.add_argument("--trial-batch", type=int, default=4)
+    p.add_argument("--num-policy", type=int, default=5)
+    p.add_argument("--num-op", type=int, default=2)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--cv-ratio", type=float, default=0.4)
+    p.add_argument("--actors", type=int, default=1)
+    p.add_argument("--queue-depth", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir (default: a fresh tempdir, removed "
+                        "on success)")
+    p.add_argument("--out", default=None, help="also write the JSON line here")
+    args = p.parse_args(argv)
+
+    from bench import host_contention_stamp, refuse_or_flag_contention
+    from bench_tpe import bench_ask_tell_latency
+
+    contention = refuse_or_flag_contention(host_contention_stamp())
+    print(f"contention: {json.dumps(contention)}")
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="faa_bench_pipeline_")
+    made_temp = args.workdir is None
+    record = run_pipeline_bench(args, workdir)
+    record["contention"] = contention
+    # the overlap headroom the async arm hides: host ask/tell latency
+    # at this bench's trial batch (same JSON line, per the bench_tpe
+    # citation contract)
+    record["tpe_latency"] = bench_ask_tell_latency(
+        ks=(args.trial_batch,), reps=20)
+
+    for arm in ("serial", "async"):
+        a = record[arm]
+        gaps = a["dispatch_gaps"] or {}
+        print(f"{arm}: phase2 {a['phase2_secs']}s, busy_frac "
+              f"{a['device_busy_frac']}, gap p50 {gaps.get('gap_p50_ms')}ms "
+              f"p99 {gaps.get('gap_p99_ms')}ms over {gaps.get('num_gaps')} "
+              f"gaps ({gaps.get('num_dispatches')} dispatches)")
+    print(f"phase2_speedup: {record['phase2_speedup']}x")
+    busy = record["async"]["device_busy_frac"] or 0.0
+    ok = busy >= 0.9 or (record["phase2_speedup"] or 0.0) >= 1.5
+    print("acceptance (busy_frac >= 0.9 during phase 2 OR >= 1.5x "
+          f"phase-2 speedup): {'PASS' if ok else 'FAIL'}")
+
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    if made_temp:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,55 @@ def driver_n_startup(trials: int) -> int:
     return min(20, max(5, trials // 4))
 
 
+def bench_ask_tell_latency(ks=(1, 4, 16), warm_obs: int = 60,
+                           reps: int = 30, seed: int = 0) -> list[dict]:
+    """Host-side ask/tell latency per batch size K on the real 30-D
+    policy space — the OVERLAP HEADROOM number the async pipeline bench
+    cites (``tools/bench_pipeline.py``): every millisecond the learner
+    spends in ``ask``/``tell`` is a millisecond the serial scheduler
+    holds the device idle, and exactly what ``--async-pipeline on``
+    hides behind the in-flight dispatch.
+
+    The TPE is warmed past its startup phase with `warm_obs` planted-
+    reward observations (the posterior path is the expensive one: good/
+    bad split + Parzen scoring per dimension), then `reps` ask/tell
+    round trips are timed per K.  Pure host math — no JAX, no device."""
+    import time
+
+    rng = np.random.default_rng((seed, 1))
+    target = plant_target(np.random.default_rng((seed, 2)))
+    observed_fn, _true = make_reward(target, 0.05, rng)
+    space = make_search_space(NUM_POLICY, NUM_OP)
+    rows = []
+    for k in ks:
+        opt = TPE(space, seed=seed, n_startup=driver_n_startup(200))
+        for _ in range(warm_obs):
+            x = opt._random_sample()
+            opt.tell(x, observed_fn(x))
+        ask_secs = np.empty(reps)
+        tell_secs = np.empty(reps)
+        for r in range(reps):
+            t0 = time.perf_counter()
+            ps = opt.ask(k)
+            t1 = time.perf_counter()
+            opt.tell_batch(ps, [observed_fn(p) for p in ps])
+            t2 = time.perf_counter()
+            ask_secs[r] = t1 - t0
+            tell_secs[r] = t2 - t1
+        rows.append({
+            "k": int(k),
+            "warm_obs": int(warm_obs),
+            "reps": int(reps),
+            "ask_ms_mean": round(float(ask_secs.mean()) * 1e3, 4),
+            "ask_ms_p99": round(float(np.percentile(ask_secs, 99)) * 1e3, 4),
+            "ask_ms_per_trial": round(
+                float(ask_secs.mean()) * 1e3 / k, 4),
+            "tell_ms_mean": round(float(tell_secs.mean()) * 1e3, 4),
+            "asks_per_sec": round(1.0 / float(ask_secs.mean()), 2),
+        })
+    return rows
+
+
 def run_strategy(strategy: str, trials: int, seed: int, noise: float,
                  n_startup: int | None = None) -> np.ndarray:
     """TRUE reward of the incumbent (best-by-OBSERVED) after each trial.
@@ -136,6 +185,10 @@ def main(argv=None):
     p.add_argument("--noise", type=float, nargs="+", default=[0.02, 0.05, 0.1],
                    help="observation-noise sigmas (0.05-0.1 matches the "
                         "round-2 fold-TTA spread; VERDICT round 2 weak 4)")
+    p.add_argument("--latency-ks", type=int, nargs="+", default=[1, 4, 16],
+                   help="batch sizes for the host-side ask/tell latency "
+                        "rows (the pipeline bench's overlap-headroom "
+                        "citation)")
     p.add_argument("--report", default=None)
     args = p.parse_args(argv)
 
@@ -147,6 +200,17 @@ def main(argv=None):
 
     contention = refuse_or_flag_contention(host_contention_stamp())
     print(f"contention: {json.dumps(contention)}")
+
+    # host-side ask/tell latency per K: the overlap-headroom numbers
+    # the async pipeline bench cites (one JSON line, machine-readable)
+    latency = bench_ask_tell_latency(ks=tuple(args.latency_ks))
+    print("tpe_latency: " + json.dumps(
+        {"contention": contention, "rows": latency}))
+    for row in latency:
+        print(f"  K={row['k']}: ask {row['ask_ms_mean']:.2f} ms "
+              f"(p99 {row['ask_ms_p99']:.2f}, "
+              f"{row['ask_ms_per_trial']:.2f}/trial), "
+              f"tell {row['tell_ms_mean']:.3f} ms")
 
     cells = []
     for trials in args.trials:

@@ -52,6 +52,16 @@ R6  **no unbounded blocking in the serving hot path** (``serve/``):
     matched by the ``self.event = threading.Event()`` construction in
     the request class).
 
+R7  **no unbounded blocking in the search pipeline** (``search/``):
+    the R6 rule set extended to the async actor/learner scheduler
+    (``search/pipeline.py``) and everything around it — an untimed
+    ``Queue.put``/``Queue.get``, ``Event``/``Condition`` ``.wait``,
+    ``Thread.join``, or a bare ``time.sleep`` poll loop in search
+    scope.  The pipeline's learner/actor threads coordinate through
+    queues under a preemption contract (SIGTERM must reach exit 77
+    promptly); one untimed wait turns a lost actor into a wedged
+    search.  Gated from day one so new pipeline code cannot regress.
+
 Suppress a finding (sparingly, with a reason nearby) by putting
 ``robust: allow`` in a comment on the offending line.
 
@@ -91,6 +101,11 @@ JIT_SEAM_DIRS = ("train", "search", "serve")
 # deadline-bounded (handler threads, the coalescing worker, the
 # supervision loops) — docs/RESILIENCE.md "Serving under overload".
 SERVE_BLOCKING_DIRS = ("serve",)
+
+# R7 scope: the search layer — the async actor/learner pipeline
+# (search/pipeline.py) threads dispatches concurrently under the same
+# no-thread-parks-forever contract as serving.
+SEARCH_BLOCKING_DIRS = ("search",)
 
 # constructor names whose instances carry blocking .join()/.get()
 _THREAD_CTORS = {"Thread", "Timer"}
@@ -278,10 +293,12 @@ def check_source(src: str, relpath: str,
                  artifact_scope: bool | None = None,
                  blocking_scope: bool | None = None,
                  jit_scope: bool | None = None,
-                 serve_scope: bool | None = None) -> list[Finding]:
+                 serve_scope: bool | None = None,
+                 search_scope: bool | None = None) -> list[Finding]:
     """Lint one file's source.  `artifact_scope` forces R3 on/off,
     `blocking_scope` forces R4 on/off, `jit_scope` forces R5 on/off,
-    `serve_scope` forces R6 on/off (None = derive from `relpath`)."""
+    `serve_scope` forces R6 on/off, `search_scope` forces R7 on/off
+    (None = derive from `relpath`)."""
     findings: list[Finding] = []
     lines = src.splitlines()
 
@@ -307,19 +324,28 @@ def check_source(src: str, relpath: str,
         jit_scope = _in_dirs(JIT_SEAM_DIRS)
     if serve_scope is None:
         serve_scope = _in_dirs(SERVE_BLOCKING_DIRS)
+    if search_scope is None:
+        search_scope = _in_dirs(SEARCH_BLOCKING_DIRS)
     blockers = _blocking_receivers(tree) if blocking_scope else set()
+    # R6 (serve/) and R7 (search/) share one rule engine; a file lives
+    # in at most one of the two scopes
+    bounded_rule = "R6" if serve_scope else ("R7" if search_scope else None)
+    bounded_where = "serve/" if serve_scope else "search/"
+    bounded_contract = (
+        "the overload contract" if serve_scope
+        else "the pipeline preemption contract")
     r6_keys: set[str] = set()
     r6_suffixes: set[str] = set()
-    if serve_scope:
+    if bounded_rule:
         r6_keys, r6_suffixes = _r6_receivers(tree)
         for call in _sleep_in_while(tree):
             if not allowed(call.lineno):
                 findings.append(Finding(
-                    relpath, call.lineno, "R6",
-                    "bare time.sleep inside a while loop in serve/ — a "
-                    "poll loop with no deadline; use Event.wait(timeout) "
-                    "or a bounded Condition.wait so shutdown/overload "
-                    "can interrupt it"))
+                    relpath, call.lineno, bounded_rule,
+                    f"bare time.sleep inside a while loop in "
+                    f"{bounded_where} — a poll loop with no deadline; "
+                    "use Event.wait(timeout) or a bounded "
+                    "Condition.wait so shutdown can interrupt it"))
 
     # enclosing-function map for the R3 allowlist
     func_of: dict[int, str] = {}
@@ -381,7 +407,7 @@ def check_source(src: str, relpath: str,
                     f"untimed blocking .{f.attr}() on a Thread/Queue — "
                     "pass a timeout (the watchdog contract: supervision "
                     "code must never be able to hang forever)"))
-        if serve_scope and isinstance(node, ast.Call):
+        if bounded_rule and isinstance(node, ast.Call):
             f = node.func
             if isinstance(f, ast.Attribute) and f.attr in _R6_METHODS \
                     and not _r6_bounded(node, f.attr) \
@@ -394,11 +420,11 @@ def check_source(src: str, relpath: str,
                     suffix = key.split(".")[-1]
                 if (key in r6_keys) or (suffix in r6_suffixes):
                     findings.append(Finding(
-                        relpath, node.lineno, "R6",
-                        f"unbounded blocking .{f.attr}() in serve/ — the "
-                        "overload contract: no handler/worker thread may "
-                        "park forever; pass a timeout (or non-blocking "
-                        "form) and shed/fail fast on expiry"))
+                        relpath, node.lineno, bounded_rule,
+                        f"unbounded blocking .{f.attr}() in "
+                        f"{bounded_where} — {bounded_contract}: no "
+                        "worker thread may park forever; pass a timeout "
+                        "(or non-blocking form) and fail fast on expiry"))
         if jit_scope and isinstance(node, ast.Attribute) \
                 and node.attr == "jit" \
                 and isinstance(node.value, ast.Name) \
