@@ -1,7 +1,12 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-overload bench-compile bench-pipeline clean reproduce
+.PHONY: test test-t1 lint-robust native bench bench-aug bench-dispatch bench-serve bench-overload bench-compile bench-pipeline trace status clean reproduce
+
+# telemetry journal dir for the trace/status targets (override:
+#   make trace TELEMETRY=/shared/run TRACE_OUT=overlap.json)
+TELEMETRY ?= telemetry
+TRACE_OUT ?= trace.json
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
@@ -73,6 +78,19 @@ bench-compile:
 # FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host, exit 3).
 bench-pipeline:
 	python tools/bench_pipeline.py
+
+# render a --telemetry journal dir as a Chrome trace (open the output
+# in chrome://tracing or ui.perfetto.dev): per-thread dispatch spans,
+# phase-1/phase-2 overlap lanes, shed/breaker/watchdog markers
+# (docs/OBSERVABILITY.md "Timelines")
+trace:
+	python tools/trace_export.py --telemetry $(TELEMETRY) --out $(TRACE_OUT)
+
+# one fleet table from telemetry journals + fleet heartbeats under a
+# shared dir: per-host busy-frac, dispatch-gap p50/p99, incident
+# counts, reclaimed units (docs/OBSERVABILITY.md "Fleet status")
+status:
+	python tools/faa_status.py --dir $(TELEMETRY)
 
 clean:
 	$(MAKE) -C native clean

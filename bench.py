@@ -163,6 +163,45 @@ def compile_cache_stamp() -> dict:
     return compile_cache_stats()
 
 
+#: version of the unified telemetry_stamp() block — bump on any key
+#: rename/removal so cross-round bench JSON comparisons can gate on it
+TELEMETRY_STAMP_SCHEMA_VERSION = 1
+
+
+def telemetry_stamp(observed_walls=(), *, fires: int = 0,
+                    label: str = "dispatch",
+                    contention: dict | None = None,
+                    watchdog: dict | None = None) -> dict:
+    """THE unified provenance block for a bench JSON line.
+
+    One schema (``schema_version`` + ``contention`` + ``watchdog`` +
+    ``compile_cache`` + the telemetry registry's counters) across
+    ``bench.py`` and every ``tools/bench_*.py`` sibling — each tool
+    used to re-implement its own stamp block from the individual
+    helpers, which is exactly how schemas drift.  Splat the result into
+    the artifact (``row.update(telemetry_stamp(...))``): the historical
+    top-level keys (``contention``/``watchdog``/``compile_cache``) keep
+    their names and shapes.
+
+    `observed_walls`/`fires`/`label` feed the shadow-watchdog stamp
+    (or pass a pre-built `watchdog` dict — per-row sweeps that already
+    stamped a per-config deadline keep it); `contention` reuses a stamp
+    captured earlier (benches capture it BEFORE compiling so their own
+    load doesn't pollute the 1-minute average) or captures one now."""
+    from fast_autoaugment_tpu.core import telemetry
+
+    return {
+        "schema_version": TELEMETRY_STAMP_SCHEMA_VERSION,
+        "contention": (contention if contention is not None
+                       else host_contention_stamp()),
+        "watchdog": (watchdog if watchdog is not None
+                     else watchdog_stamp(observed_walls, fires=fires,
+                                         label=label)),
+        "compile_cache": compile_cache_stamp(),
+        "telemetry_counters": telemetry.registry().counters_snapshot(),
+    }
+
+
 def vs_baseline(images_per_sec: float, cpu_fallback: bool) -> float | None:
     """Ratio against the reference-pipeline estimate, or None on the CPU
     fallback: comparing a CPU plumbing heartbeat against the TPU-class
@@ -587,7 +626,8 @@ def _dispatch_probe_model():
     return DispatchProbe()
 
 
-def bench_step_dispatch(ns=(1, 8, 32), steps=None) -> dict:
+def bench_step_dispatch(ns=(1, 8, 32), steps=None,
+                        telemetry_compare: bool = False) -> dict:
     """Train-step dispatch throughput: `train_steps_per_sec` at
     ``--steps-per-dispatch N`` with the device cache vs the host feed.
 
@@ -746,6 +786,91 @@ def bench_step_dispatch(ns=(1, 8, 32), steps=None) -> dict:
     top = out["train_steps_per_sec"].get(f"cache_n{max(ns)}")
     if base and top:
         out["speedup_cache_max_n_vs_hostfeed"] = round(top / base, 2)
+
+    # telemetry on-vs-off comparison row (the observability acceptance
+    # bound): the SAME cache_nN loop with telemetry fully armed —
+    # journal into a scratch dir, one span (registry histogram +
+    # rate-bounded JSONL event) per dispatch, exactly the per-dispatch
+    # cost the trainer's _monitored_dispatch seam pays with --telemetry
+    # on — measured as PAIRED ALTERNATING epochs (off, on, off, on, …)
+    # with per-arm medians: this host's run-to-run drift (~±2-3%) would
+    # otherwise swamp a microsecond-scale per-dispatch delta.  Overhead
+    # must stay <= 1% steps/s (docs/OBSERVABILITY.md "Overhead").
+    if telemetry_compare:
+        import shutil
+        import statistics
+        import tempfile
+
+        from fast_autoaugment_tpu.core import telemetry
+
+        was_on = telemetry.journal_active()
+        tmp = None
+        if not was_on:
+            tmp = tempfile.mkdtemp(prefix="faa-bench-telemetry-")
+            telemetry.enable_telemetry(tmp)  # full default config
+        pairs = max(5, repeats)
+        out["telemetry_comparison"] = {"pairs": pairs, "steps": steps}
+        try:
+            for n in ns:
+                multi = make_multistep_train_step(
+                    body, steps_per_dispatch=n, unroll=unroll)
+
+                def one_epoch(state, n_steps, with_span, n=n, multi=multi):
+                    acc = Accumulator()
+                    done = 0
+                    while done < n_steps:
+                        mat = train_index_matrix(np.arange(n_examples),
+                                                 batch, epoch=done)
+                        for lo in range(0, len(mat) - len(mat) % n, n):
+                            idx = place_index_matrix(mesh, mat[lo:lo + n])
+                            if with_span:
+                                with telemetry.span("train_dispatch",
+                                                    step=done):
+                                    state, metrics = multi(
+                                        state, cache.images, cache.labels,
+                                        idx, pol, key)
+                            else:
+                                state, metrics = multi(
+                                    state, cache.images, cache.labels,
+                                    idx, pol, key)
+                            acc.add_dict(metrics)
+                            done += n
+                            if done >= n_steps:
+                                break
+                    return state
+
+                state = one_epoch(fresh_state(), n, True)  # warm
+                jax.block_until_ready(state.params)
+                rates = {False: [], True: []}
+                for p in range(pairs):
+                    # alternate the within-pair order: process state
+                    # (allocator, caches) drifts monotonically, so a
+                    # fixed off-then-on order reads that drift as
+                    # telemetry overhead
+                    order = (False, True) if p % 2 == 0 else (True, False)
+                    for with_span in order:
+                        state = fresh_state()
+                        t0 = time.perf_counter()
+                        state = one_epoch(state, steps, with_span)
+                        jax.block_until_ready(state.params)
+                        rates[with_span].append(
+                            steps / (time.perf_counter() - t0))
+                off = statistics.median(rates[False])
+                on = statistics.median(rates[True])
+                out["telemetry_comparison"][f"cache_n{n}"] = {
+                    "steps_per_sec_off": round(off, 2),
+                    "steps_per_sec_on": round(on, 2),
+                    "overhead_frac": round(1.0 - on / off, 4),
+                }
+                _log(f"step dispatch cache N={n} telemetry off/on "
+                     f"(median of {pairs} alternating pairs): "
+                     f"{off:.1f} / {on:.1f} steps/s "
+                     f"({(1.0 - on / off) * 100:+.2f}%)")
+        finally:
+            if not was_on:
+                telemetry._disable_for_tests()  # detach the scratch journal
+                if tmp:
+                    shutil.rmtree(tmp, ignore_errors=True)
     # per-config shadow-watchdog stamp from the implied per-dispatch
     # wall (a cache_nN dispatch advances N steps)
     out["watchdog"] = {
@@ -770,22 +895,27 @@ def main():
     arm_compile_cache_from_env()
     if "--dispatch-only" in sys.argv:
         # `make bench-dispatch`: just the step-dispatch/device-cache
-        # sweep, one JSON line (same stamp discipline as the headline)
-        sd = bench_step_dispatch()
-        print(json.dumps({
+        # sweep, one JSON line (same stamp discipline as the headline),
+        # plus the telemetry on-vs-off comparison row (the <=1% overhead
+        # bound — docs/OBSERVABILITY.md)
+        sd = bench_step_dispatch(telemetry_compare=True)
+        row = {
             "metric": "train_steps_per_sec",
             "train_steps_per_sec": sd["train_steps_per_sec"],
+            "telemetry_comparison": sd.get("telemetry_comparison"),
             "compile_sec": sd["compile_sec"],
             "probe": sd["probe"],
             "speedup_cache_max_n_vs_hostfeed": sd.get(
                 "speedup_cache_max_n_vs_hostfeed"),
-            "watchdog": sd.get("watchdog"),
-            "compile_cache": compile_cache_stamp(),
             "backend": ("cpu-fallback"
                         if os.environ.get("FAA_BENCH_CPU_FALLBACK")
                         else __import__("jax").devices()[0].platform),
-            "contention": contention,
-        }))
+        }
+        row.update(telemetry_stamp(contention=contention))
+        # per-config shadow-watchdog detail (telemetry_stamp carries the
+        # single-label stamp; the sweep's per-(N, cache) table rides on)
+        row["watchdog"] = sd.get("watchdog")
+        print(json.dumps(row))
         return
     import jax
     import jax.numpy as jnp
@@ -923,16 +1053,12 @@ def main():
         "step_time_stddev_sec": round(step_time_stddev, 6),
         "batch_per_device": BATCH_PER_DEVICE,
         "devices": n_dev,
-        # unified compile-tax provenance (same block in every
-        # tools/bench_*.py JSON line): cache dir + hit/miss counts +
-        # per-label first-call seconds through the seam
-        "compile_cache": compile_cache_stamp(),
-        "contention": contention,
-        # hang-vs-straggler provenance (docs/RESILIENCE.md): the
-        # auto-watchdog deadline these step walls imply + fires (0 —
-        # the bench is unmonitored)
-        "watchdog": watchdog_stamp(step_times, label="train_step"),
     }
+    # unified provenance block (schema_version + contention + shadow
+    # watchdog + compile cache + telemetry counters) — ONE helper across
+    # bench.py and every tools/bench_*.py sibling (docs/OBSERVABILITY.md)
+    out.update(telemetry_stamp(step_times, label="train_step",
+                               contention=contention))
 
     # search-scheduler throughput: trials/sec at --trial-batch K
     # (FAA_BENCH_TTA=0 skips; see bench_tta_scheduler docstring)

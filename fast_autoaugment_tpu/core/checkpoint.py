@@ -133,6 +133,14 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
     with open(tmp_meta, "w") as fh:
         json.dump(meta, fh)
     os.replace(tmp_meta, _meta_path(path))
+    # journal evidence (no-op with telemetry off): when/where state hit
+    # disk — the trace export renders these as checkpoint markers
+    from fast_autoaugment_tpu.core import telemetry
+
+    telemetry.registry().counter(
+        "faa_checkpoints_saved_total", "checkpoint chain saves").inc()
+    telemetry.emit("checkpoint", os.path.basename(path), action="save",
+                   nbytes=len(payload), epoch=meta.get("epoch"))
 
 
 def _read_payload(path: str) -> bytes:
@@ -183,7 +191,23 @@ def load_checkpoint(path: str, target: Any, lenient: bool = False,
     """
     payload = _read_payload(path)
     if verify:
-        _verify_payload(path, payload)
+        try:
+            _verify_payload(path, payload)
+        except CheckpointCorruptError:
+            from fast_autoaugment_tpu.core import telemetry
+
+            telemetry.registry().counter(
+                "faa_checkpoints_corrupt_total",
+                "checkpoint loads failing digest/size verification").inc()
+            telemetry.emit("checkpoint", os.path.basename(path),
+                           action="corrupt")
+            raise
+    from fast_autoaugment_tpu.core import telemetry
+
+    telemetry.registry().counter(
+        "faa_checkpoints_loaded_total", "checkpoint restores").inc()
+    telemetry.emit("checkpoint", os.path.basename(path), action="load",
+                   nbytes=len(payload))
     if not lenient:
         return serialization.from_bytes(target, payload)
 

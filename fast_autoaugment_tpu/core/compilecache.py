@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = [
@@ -79,8 +80,15 @@ _MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 _lock = threading.Lock()
 _dir: str | None = None
-_hits = 0
-_misses = 0
+# hit/miss live in the process-wide telemetry registry (one source of
+# truth: compile_cache_stats, /metrics and the bench stamps all read
+# the same counters; pinned by tests/test_telemetry.py)
+_HITS = telemetry.registry().counter(
+    "faa_compile_cache_hits_total",
+    "persistent-compile-cache modules deserialized instead of compiled")
+_MISSES = telemetry.registry().counter(
+    "faa_compile_cache_misses_total",
+    "persistent-compile-cache modules compiled fresh")
 # per-seam-label first-call evidence:
 # {label: {"sec": float, "hit": n, "miss": n, "uncached": n, "none": n}}
 _labels: dict[str, dict] = {}
@@ -88,13 +96,10 @@ _listener_registered = False
 
 
 def _listener(event: str, **_kwargs: Any) -> None:
-    global _hits, _misses
     if event == _HIT_EVENT:
-        with _lock:
-            _hits += 1
+        _HITS.inc()
     elif event == _MISS_EVENT:
-        with _lock:
-            _misses += 1
+        _MISSES.inc()
 
 
 def resolve_compile_cache(spec: str | None = None) -> str | None:
@@ -173,13 +178,11 @@ def process_is_warm() -> bool:
     this to shrink its first-call compile allowance
     (``core/watchdog.py``) — a miss anywhere means cold compiles may
     still be coming and the generous window stays."""
-    with _lock:
-        return _dir is not None and _hits > 0 and _misses == 0
+    return _dir is not None and _HITS.value > 0 and _MISSES.value == 0
 
 
 def _snapshot() -> tuple[int, int]:
-    with _lock:
-        return _hits, _misses
+    return int(_HITS.value), int(_MISSES.value)
 
 
 def _classify(h0: int, m0: int) -> str:
@@ -189,8 +192,7 @@ def _classify(h0: int, m0: int) -> str:
     in-process tracing cache already held the executable)."""
     if _dir is None:
         return "uncached"
-    with _lock:
-        dh, dm = _hits - h0, _misses - m0
+    dh, dm = int(_HITS.value) - h0, int(_MISSES.value) - m0
     if dm > 0:
         return "miss"
     if dh > 0:
@@ -204,6 +206,10 @@ def _record(label: str, sec: float, verdict: str) -> None:
             label, {"sec": 0.0, "hit": 0, "miss": 0, "uncached": 0, "none": 0})
         rec["sec"] += float(sec)
         rec[verdict] += 1
+    # journal evidence (no-op with telemetry off): when/where this
+    # process paid its compile tax, and whether the cache absorbed it
+    telemetry.emit("compile", label, sec=round(float(sec), 6),
+                   verdict=verdict, cache_dir=_dir)
     if sec >= 1.0:
         logger.info("compile seam %r: first call %.1fs (%s)",
                     label, sec, verdict)
@@ -297,23 +303,25 @@ def compile_cache_stats() -> dict:
                  "none": r["none"]}
             for lb, r in sorted(_labels.items())
         }
-        return {
-            "dir": _dir,
-            "enabled": _dir is not None,
-            "hits": _hits,
-            "misses": _misses,
-            "first_step_secs": round(sum(r["sec"] for r in _labels.values()), 3),
-            "labels": labels,
-        }
+        first_step = round(sum(r["sec"] for r in _labels.values()), 3)
+    return {
+        "dir": _dir,
+        "enabled": _dir is not None,
+        # sourced from the telemetry registry — the same counters a
+        # /metrics scrape exports (equality pinned by tests)
+        "hits": int(_HITS.value),
+        "misses": int(_MISSES.value),
+        "first_step_secs": first_step,
+        "labels": labels,
+    }
 
 
 def _reset_stats_for_tests() -> None:
     """Zero the counters/labels (NOT the cache config) — test isolation
     only; the listener stays registered."""
-    global _hits, _misses
+    _HITS._reset()
+    _MISSES._reset()
     with _lock:
-        _hits = 0
-        _misses = 0
         _labels.clear()
 
 

@@ -135,7 +135,9 @@ class CircuitBreaker:
     admission fast-fail and ``/readyz``.
     """
 
-    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0):
+    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0,
+                 name: str = "breaker"):
+        self.name = str(name)
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
         self.state = "closed"
@@ -211,10 +213,25 @@ class CircuitBreaker:
                 self.state = "open"
                 self.fires += 1
                 self._opened_at = time.monotonic()
+                fires = self.fires
+                failures = self.consecutive_failures
                 logger.error(
                     "circuit breaker OPEN (fire #%d, %d consecutive "
                     "failures) — failing fast for %.1fs",
-                    self.fires, self.consecutive_failures, self.cooldown_s)
+                    fires, failures, self.cooldown_s)
+            else:
+                return
+        # outside the lock: telemetry evidence for the OPEN transition
+        # (core/telemetry.py — counter always, journal when armed)
+        from fast_autoaugment_tpu.core import telemetry
+
+        telemetry.registry().counter(
+            "faa_breaker_fires_total",
+            "circuit-breaker transitions into OPEN",
+            breaker=self.name).inc()
+        telemetry.emit("breaker_fire", self.name, fires=fires,
+                       consecutive_failures=failures,
+                       cooldown_s=self.cooldown_s)
 
     def snapshot(self) -> dict:
         """Artifact-ready accounting (stamped into ``/stats`` and the

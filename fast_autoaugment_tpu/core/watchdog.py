@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.core.resilience import DispatchHungError
 from fast_autoaugment_tpu.utils.logging import get_logger
 
@@ -234,6 +235,13 @@ class DispatchWatchdog:
             else:
                 self._ema[label] = (self.ema_alpha * float(wall_sec)
                                     + (1.0 - self.ema_alpha) * prev)
+            ema = self._ema[label]
+        # registry mirror (telemetry): the EMA any /metrics scrape or
+        # bench stamp reads is the one the deadline math uses
+        telemetry.registry().gauge(
+            "faa_watchdog_ema_seconds",
+            "per-label EMA of observed dispatch wall seconds",
+            label=label).set(ema)
 
     def run(self, label: str, fn: Callable, *args: Any,
             inject_delay: float = 0.0) -> Any:
@@ -278,6 +286,13 @@ class DispatchWatchdog:
                 self.fires += 1
                 ema = self._ema.get(label)
             waited = time.monotonic() - t0
+            telemetry.registry().counter(
+                "faa_watchdog_fires_total",
+                "dispatch watchdog deadline expiries", label=label).inc()
+            telemetry.emit("watchdog_fire", label,
+                           deadline_sec=round(deadline, 3),
+                           waited_sec=round(waited, 3),
+                           ema_sec=None if ema is None else round(ema, 6))
             logger.error(
                 "watchdog FIRED on %r: no completion after %.1fs "
                 "(deadline %.1fs, ema %s) — dispatch presumed hung",

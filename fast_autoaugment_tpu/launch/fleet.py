@@ -326,7 +326,8 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
 def launch_fleet(hosts: list[str], command: list[str],
                  coordinator: str | None,
                  env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",
-                                                     "FAA_COMPILE_CACHE"),
+                                                     "FAA_COMPILE_CACHE",
+                                                     "FAA_TELEMETRY"),
                  host_retries: int = 0,
                  retry_backoff: float = 1.0,
                  elastic: bool = False,
@@ -467,6 +468,13 @@ def main(argv=None):
                         "Point it at a directory all hosts mount; the "
                         "worker CLIs pick it up without extra flags "
                         "(core/compilecache.py)")
+    p.add_argument("--telemetry", default=None, metavar="DIR",
+                   help="shared flight-recorder journal dir: exported to "
+                        "every host (and every retry) as FAA_TELEMETRY so "
+                        "each worker journals under DIR with its own "
+                        "host/attempt identity; tools/faa_status.py "
+                        "aggregates the result into one fleet table "
+                        "(core/telemetry.py)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run on every host (prefix with --)")
     args = p.parse_args(argv)
@@ -480,6 +488,10 @@ def main(argv=None):
         # every host launch (retries included) — setting it here is the
         # whole fleet-sharing contract
         os.environ["FAA_COMPILE_CACHE"] = args.compile_cache
+    if args.telemetry and args.telemetry.lower() != "off":
+        # same contract as the compile cache: the env-passthrough list
+        # forwards FAA_TELEMETRY to every host launch and retry
+        os.environ["FAA_TELEMETRY"] = args.telemetry
     hosts = expand_hosts(args.hosts)
     code = launch_fleet(hosts, command, args.coordinator,
                         host_retries=args.host_retries,

@@ -285,6 +285,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "an inherited FAA_COMPILE_CACHE; caching never "
                         "changes numerics).  The fleet launcher's "
                         "--compile-cache exports the dir to every host")
+    p.add_argument("--telemetry", default="off", metavar="{off,DIR}",
+                   help="flight-recorder journal (core/telemetry.py): "
+                        "typed dispatch/compile/checkpoint/lease/trial "
+                        "events under DIR with rotation-bounded size, "
+                        "renderable as a Chrome trace via tools/"
+                        "trace_export.py and aggregated fleet-wide via "
+                        "tools/faa_status.py.  'off' (default, bit-for-"
+                        "bit — no journal I/O) still honors an inherited "
+                        "FAA_TELEMETRY")
+    p.add_argument("--telemetry-port", type=int, default=0,
+                   help="serve GET /metrics (Prometheus text exposition "
+                        "of the in-memory telemetry registry, read-only) "
+                        "on this port while the search runs.  0 = off")
     p.add_argument("--audit-floor", type=float, default=0.95,
                    help="drop selected sub-policies whose standalone "
                         "mean-over-draws fold accuracy < floor x baseline "
@@ -310,6 +323,15 @@ def main(argv=None):
     # checkpoints at its next safe boundary (per-trial logs are already
     # persisted per round) and the process exits 77 = "resume me"
     install_signal_handlers()
+    from fast_autoaugment_tpu.core import telemetry
+
+    # journal + read-only /metrics exposition (core/telemetry.py);
+    # both default off = the historical stream
+    telemetry.configure_telemetry(args.telemetry)
+    metrics_httpd = None
+    if args.telemetry_port:
+        metrics_httpd, _port = telemetry.start_metrics_server(
+            args.telemetry_port)
     t_start = time.time()
 
     try:
@@ -319,6 +341,8 @@ def main(argv=None):
             "preempted (%s) — exiting %d; rerunning the same command "
             "resumes from the per-fold checkpoints and trial log",
             e, PREEMPTED_EXIT_CODE)
+        telemetry.emit("preempt", "search_cli", kind="preempted",
+                       exit_code=PREEMPTED_EXIT_CODE)
         raise SystemExit(PREEMPTED_EXIT_CODE)
     except DispatchHungError as e:
         logger.error(
@@ -326,7 +350,12 @@ def main(argv=None):
             "unrecoverable; exiting %d so the supervisor relaunches and "
             "the rerun resumes from the newest checkpoint-chain link",
             e, PREEMPTED_EXIT_CODE)
+        telemetry.emit("preempt", "search_cli", kind="dispatch_hung",
+                       label_detail=e.label, exit_code=PREEMPTED_EXIT_CODE)
         raise SystemExit(PREEMPTED_EXIT_CODE)
+    finally:
+        if metrics_httpd is not None:
+            metrics_httpd.shutdown()
 
 
 def _build_workqueue(args):
@@ -385,6 +414,7 @@ def _run(args, conf, t_start):
         async_pipeline=args.async_pipeline,
         pipeline_actors=args.pipeline_actors,
         pipeline_queue_depth=args.pipeline_queue_depth,
+        telemetry_spec=args.telemetry,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []

@@ -107,6 +107,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "'off' (default) = the historical behavior "
                         "(still honors an inherited FAA_COMPILE_CACHE; "
                         "caching never changes numerics)")
+    p.add_argument("--telemetry", default="off", metavar="{off,DIR}",
+                   help="flight-recorder journal (core/telemetry.py): "
+                        "typed dispatch/compile/checkpoint events under "
+                        "DIR with rotation-bounded size, renderable as a "
+                        "Chrome trace via tools/trace_export.py.  'off' "
+                        "(default, bit-for-bit — no journal I/O) still "
+                        "honors an inherited FAA_TELEMETRY")
+    p.add_argument("--telemetry-port", type=int, default=0,
+                   help="serve GET /metrics (Prometheus text exposition "
+                        "of the in-memory telemetry registry, read-only) "
+                        "while training runs.  0 = off")
     p.add_argument("--coordinator", default=None, help="host0 addr for multi-host")
     p.add_argument("--num-hosts", type=int, default=None)
     p.add_argument("--host-id", type=int, default=None)
@@ -131,6 +142,13 @@ def main(argv=None):
     # SIGTERM/SIGUSR1 -> graceful preemption: checkpoint at the next
     # safe boundary, exit 77 ("resume me" — docs/RESILIENCE.md)
     install_signal_handlers()
+    from fast_autoaugment_tpu.core import telemetry
+
+    telemetry.configure_telemetry(args.telemetry)
+    metrics_httpd = None
+    if args.telemetry_port:
+        metrics_httpd, _port = telemetry.start_metrics_server(
+            args.telemetry_port)
     t0 = time.time()
     try:
         result = train_and_eval(
@@ -156,13 +174,20 @@ def main(argv=None):
     except PreemptedError as e:
         logger.warning("preempted (%s) — exiting %d so the supervisor "
                        "resumes this run", e, PREEMPTED_EXIT_CODE)
+        telemetry.emit("preempt", "train_cli", kind="preempted",
+                       exit_code=PREEMPTED_EXIT_CODE)
         raise SystemExit(PREEMPTED_EXIT_CODE)
     except DispatchHungError as e:
         logger.error("dispatch HUNG (%s) — in-flight device state is "
                      "unrecoverable; exiting %d so the supervisor "
                      "relaunches and the rerun resumes from the newest "
                      "checkpoint-chain link", e, PREEMPTED_EXIT_CODE)
+        telemetry.emit("preempt", "train_cli", kind="dispatch_hung",
+                       label_detail=e.label, exit_code=PREEMPTED_EXIT_CODE)
         raise SystemExit(PREEMPTED_EXIT_CODE)
+    finally:
+        if metrics_httpd is not None:
+            metrics_httpd.shutdown()
     elapsed = time.time() - t0
     cc = result.get("compile_cache") or {}
     if cc:

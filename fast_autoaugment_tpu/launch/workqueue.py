@@ -52,6 +52,7 @@ from __future__ import annotations
 import os
 import time
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.utils import faultinject
 from fast_autoaugment_tpu.utils.logging import get_logger
 
@@ -103,6 +104,16 @@ class WorkQueue:
         #: the global view comes from the done markers' attempt counts)
         self.reclaimed_units: list[str] = []
 
+    def _lease_event(self, action: str, unit: str, **fields) -> None:
+        """Registry counter + journal ``lease`` event for one lease
+        transition (claim/reclaim/lost/release) — the fleet-status tool
+        and the trace export read these (core/telemetry.py)."""
+        telemetry.registry().counter(
+            "faa_lease_events_total", "workqueue lease transitions",
+            action=action).inc()
+        telemetry.emit("lease", unit, action=action, owner=self.owner,
+                       **fields)
+
     # -- paths ---------------------------------------------------------
     def _lease_path(self, unit: str) -> str:
         return os.path.join(self._leases, f"{_safe(unit)}.json")
@@ -140,7 +151,10 @@ class WorkQueue:
         path = self._lease_path(unit)
         lease = _read_json(path)
         if lease is None:
-            return self._claim_fresh(unit, attempt=1)
+            claimed = self._claim_fresh(unit, attempt=1)
+            if claimed:
+                self._lease_event("claim", unit, lease_attempt=1)
+            return claimed
         if lease.get("owner") == self.owner:
             # our own lease (a relaunch of this owner resuming its
             # unit): refresh the heartbeat and carry on
@@ -174,6 +188,9 @@ class WorkQueue:
             self._write_lease(unit, attempt=attempt,
                               reclaimed_from=dead_owner)
             self.reclaimed_units.append(unit)
+            self._lease_event("reclaim", unit, lease_attempt=attempt,
+                              reclaimed_from=dead_owner,
+                              stale_sec=round(age, 3))
             return True
         finally:
             try:
@@ -259,6 +276,9 @@ class WorkQueue:
             return  # injected wedged-heartbeat: silently drop the beat
         lease = _read_json(self._lease_path(unit))
         if lease is None or lease.get("owner") != self.owner:
+            self._lease_event("lost", unit,
+                              new_owner=None if lease is None
+                              else lease.get("owner"))
             raise LeaseLostError(
                 f"lease on {unit!r} is {'gone' if lease is None else 'owned by ' + repr(lease.get('owner'))}"
                 f" — this host was declared dead and the unit reclaimed")
@@ -280,6 +300,7 @@ class WorkQueue:
         if info:
             rec["info"] = info
         write_json_atomic(self._done_path(unit), rec)
+        self._lease_event("release", unit, lease_attempt=rec["attempt"])
         if lease.get("owner") == self.owner:
             try:
                 os.remove(self._lease_path(unit))

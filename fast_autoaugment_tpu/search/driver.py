@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.core.checkpoint import load_checkpoint, read_metadata
 from fast_autoaugment_tpu.core.compilecache import (
     compile_cache_stats,
@@ -56,6 +57,7 @@ from fast_autoaugment_tpu.core.resilience import (
     DispatchHungError,
     PreemptedError,
 )
+from fast_autoaugment_tpu.core.telemetry import wall
 from fast_autoaugment_tpu.core.watchdog import resolve_watchdog
 from fast_autoaugment_tpu.data.datasets import cv_split, load_dataset
 from fast_autoaugment_tpu.models import get_model, num_class
@@ -82,6 +84,25 @@ __all__ = ["search_policies", "make_search_space", "SearchResult",
            "write_json_atomic", "draw_random_policy_set"]
 
 logger = get_logger("faa_tpu.search")
+
+
+def phase1_device_seconds_attribution(sw, fold_list, stack_groups) -> dict:
+    """Per-fold device-seconds from the phase-1 stopwatch ledger.
+
+    ``phase1_fold<k>`` phases (initial train + gate retrains, the same
+    accumulating name) credit fold k directly; each ``phase1_stack<i>``
+    phase (one measured wall for a whole fold-stacked group) splits
+    evenly over `stack_groups[i]`.  The ``device_secs_phase1_per_fold``
+    stamp in ``search_result.json`` is THIS function over THIS stopwatch
+    — one ledger (mirrored into the telemetry registry as
+    ``faa_phase_device_seconds`` gauges), so the stamp cannot drift from
+    the measurement; equality is pinned by tests/test_telemetry.py."""
+    attr = {int(f): sw.device_seconds(f"phase1_fold{f}") for f in fold_list}
+    for i, group in enumerate(stack_groups):
+        share = sw.device_seconds(f"phase1_stack{i}") / len(group)
+        for f in group:
+            attr[int(f)] = attr.get(int(f), 0.0) + share
+    return attr
 
 
 def resolve_quality_floor(floor, num_classes: int) -> float | None:
@@ -516,6 +537,7 @@ def search_policies(
     async_pipeline: str | bool = "off",
     pipeline_actors: int = 1,
     pipeline_queue_depth: int = 1,
+    telemetry_spec: str = "off",
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -680,12 +702,16 @@ def search_policies(
     # units warm-start; every compile this search pays is classified
     # hit/miss and stamped into search_result.json['compile_cache']
     configure_compile_cache(compile_cache)
+    # flight-recorder journal (core/telemetry.py): "off" (default,
+    # bit-for-bit — no file I/O, no new artifact keys) still honors an
+    # inherited FAA_TELEMETRY, the fleet/relaunch handoff
+    telemetry.configure_telemetry(telemetry_spec)
     fold_quality_floor = resolve_quality_floor(
         fold_quality_floor, num_class(conf["dataset"])
     )
     os.makedirs(save_dir, exist_ok=True)
     mesh = make_mesh()
-    watch = {"start": time.time()}
+    watch = {"start": wall()}
     result = SearchResult()
     # device-hours ledger provenance (VERDICT r4 weak 5): the ``tpu_
     # secs_*`` fields are wall x device_count on WHATEVER backend ran —
@@ -803,18 +829,24 @@ def search_policies(
     excluded_folds: list[int] = []
 
     # ---------------- phase 1: pretrain without augmentation ----------
-    t0 = time.time()
+    t0 = wall()
     no_aug_conf = conf.replace(aug="default")
     if phase1_epochs:
         no_aug_conf = no_aug_conf.replace(epoch=int(phase1_epochs))
     fold_paths = [_fold_ckpt_path(save_dir, conf, f, cv_ratio)
                   for f in range(cv_num)]
     phase1_epochs_eff = int(no_aug_conf["epoch"])
-    # per-fold device-seconds attribution: training wall x device_count
-    # credited to the fold it trained (stacked groups split their one
-    # measured wall evenly) — device_secs_phase1 stays the once-recorded
-    # phase total; the attribution must sum to (at most) it
-    phase1_attr: dict[int, float] = {f: 0.0 for f in fold_list}
+    # per-fold device-seconds attribution: every phase-1 training wall
+    # is measured on ONE PhaseStopwatch ledger (utils/profiling.py,
+    # mirrored into the telemetry registry) and attributed per fold by
+    # phase1_device_seconds_attribution — stacked groups split their one
+    # measured wall evenly; device_secs_phase1 stays the once-recorded
+    # phase total and the attribution must sum to (at most) it
+    from fast_autoaugment_tpu.utils.profiling import PhaseStopwatch
+
+    phase1_sw = PhaseStopwatch(device_count=mesh.size,
+                               registry=telemetry.registry())
+    stack_groups: list[list[int]] = []
 
     def _needs_training(fold: int) -> bool:
         meta = read_metadata(fold_paths[fold])
@@ -852,15 +884,13 @@ def search_policies(
             group = pending[lo:lo + stack_k]
             logger.info("phase1: training folds %s fold-stacked (K=%d)",
                         group, len(group))
-            t_g = time.time()
-            train_folds_stacked(
-                no_aug_conf, dataroot, cv_ratio=cv_ratio, folds=group,
-                save_paths=[fold_paths[f] for f in group], seed=seed,
-                resume=resume, **train_feed_kw,
-            )
-            g_secs = (time.time() - t_g) * mesh.size
-            for f in group:
-                phase1_attr[f] += g_secs / len(group)
+            with phase1_sw.phase(f"phase1_stack{len(stack_groups)}"):
+                train_folds_stacked(
+                    no_aug_conf, dataroot, cv_ratio=cv_ratio, folds=group,
+                    save_paths=[fold_paths[f] for f in group], seed=seed,
+                    resume=resume, **train_feed_kw,
+                )
+            stack_groups.append([int(f) for f in group])
             stack_trained.update(group)
 
     def _phase1_fold(fold: int, heartbeat=None) -> None:
@@ -898,17 +928,17 @@ def search_policies(
             logger.info("phase1: fold %d trained in the stacked program", fold)
         elif not (resume and meta and meta.get("epoch", 0) >= phase1_epochs_eff):
             logger.info("phase1: training fold %d -> %s", fold, path)
-            t_f = time.time()
-            if train_fold_fn is not None:
-                _call_train_fold_fn(train_fold_fn, no_aug_conf, fold, path, seed)
-            else:
-                train_and_eval(
-                    no_aug_conf, dataroot,
-                    test_ratio=cv_ratio, cv_fold=fold,
-                    save_path=path, metric="last", seed=seed,
-                    heartbeat=heartbeat, **seq_train_kw,
-                )
-            phase1_attr[fold] += (time.time() - t_f) * mesh.size
+            with phase1_sw.phase(f"phase1_fold{fold}"):
+                if train_fold_fn is not None:
+                    _call_train_fold_fn(train_fold_fn, no_aug_conf, fold,
+                                        path, seed)
+                else:
+                    train_and_eval(
+                        no_aug_conf, dataroot,
+                        test_ratio=cv_ratio, cv_fold=fold,
+                        save_path=path, metric="last", seed=seed,
+                        heartbeat=heartbeat, **seq_train_kw,
+                    )
         else:
             logger.info("phase1: fold %d already trained (epoch %d)", fold, meta["epoch"])
 
@@ -929,22 +959,22 @@ def search_policies(
             )
             _remove_ckpt(alt)
             retry_seed = seed + 1009 * tries + fold
-            t_r = time.time()
-            if train_fold_fn is not None:
-                # same mechanism as the initial training (a caller's
-                # scatter/trainer override applies to retries too);
-                # the fresh seed is passed explicitly when the hook
-                # accepts it, and rides on conf['seed'] either way
-                _call_train_fold_fn(
-                    train_fold_fn, no_aug_conf, fold, alt, retry_seed
-                )
-            else:
-                train_and_eval(
-                    no_aug_conf, dataroot, test_ratio=cv_ratio, cv_fold=fold,
-                    save_path=alt, metric="last", seed=retry_seed,
-                    heartbeat=heartbeat, **seq_train_kw,
-                )
-            phase1_attr[fold] += (time.time() - t_r) * mesh.size
+            with phase1_sw.phase(f"phase1_fold{fold}"):
+                if train_fold_fn is not None:
+                    # same mechanism as the initial training (a caller's
+                    # scatter/trainer override applies to retries too);
+                    # the fresh seed is passed explicitly when the hook
+                    # accepts it, and rides on conf['seed'] either way
+                    _call_train_fold_fn(
+                        train_fold_fn, no_aug_conf, fold, alt, retry_seed
+                    )
+                else:
+                    train_and_eval(
+                        no_aug_conf, dataroot, test_ratio=cv_ratio,
+                        cv_fold=fold,
+                        save_path=alt, metric="last", seed=retry_seed,
+                        heartbeat=heartbeat, **seq_train_kw,
+                    )
             alt_acc = evaluator.baseline(fold, alt)
             if alt_acc > acc:
                 _replace_ckpt(alt, path)
@@ -1041,17 +1071,19 @@ def search_policies(
     def _stamp_phase1(end_time: float | None = None):
         # device_secs_* is the honest name; tpu_secs_* stays as a
         # compatibility alias for committed-artifact readers (same value)
-        end = time.time() if end_time is None else end_time
+        end = wall() if end_time is None else end_time
         result["device_secs_phase1"] = result["tpu_secs_phase1"] = (
             (end - phase1_t0) * mesh.size)
-        # per-fold attribution of the phase total: training wall x
-        # devices credited per fold (stacked groups record ONE wall
-        # measurement and split it evenly — the phase total is never
-        # double-counted); the gap between sum(per_fold) and
-        # device_secs_phase1 is the gate's baseline evals plus setup,
-        # which belong to no single fold
+        # per-fold attribution of the phase total, sourced from the ONE
+        # stopwatch ledger every phase-1 training ran under (stacked
+        # groups record ONE wall measurement and split it evenly — the
+        # phase total is never double-counted); the gap between
+        # sum(per_fold) and device_secs_phase1 is the gate's baseline
+        # evals plus setup, which belong to no single fold
+        attr = phase1_device_seconds_attribution(
+            phase1_sw, fold_list, stack_groups)
         result["device_secs_phase1_per_fold"] = {
-            str(f): phase1_attr[f] for f in sorted(phase1_attr)}
+            str(f): attr[f] for f in sorted(attr)}
         result["fold_baselines"] = {
             str(k): v for k, v in fold_baselines.items()}
         result["excluded_folds"] = list(excluded_folds)
@@ -1061,11 +1093,11 @@ def search_policies(
     if until < 2:
         result["final_policy_set"] = []
         result["compile_cache"] = compile_cache_stats()
-        result["elapsed_total"] = time.time() - watch["start"]
+        result["elapsed_total"] = wall() - watch["start"]
         return result
 
     # ---------------- phase 2: TPE search per fold --------------------
-    t0 = time.time()
+    t0 = wall()
     space = make_search_space(num_policy, num_op)
     final_policy_set = []
     # async-pipeline accounting + the cross-thread stop channel: the
@@ -1240,6 +1272,9 @@ def search_policies(
                 result["tta_executables_first"] = executable_census(
                     evaluator.tta_step)
             tpe.tell(proposal, reward)
+            telemetry.emit("trial", f"fold{fold}", fold=fold,
+                           trial=trial_idx, reward=float(reward),
+                           quarantined=failure is not None)
             fold_trials.append(
                 (proposal, reward) if failure is None
                 else (proposal, reward, failure))
@@ -1302,6 +1337,10 @@ def search_policies(
                 result["tta_batched_executables_first"] = executable_census(
                     evaluator.tta_step_batch)
             tpe.tell_batch(proposals, rewards)
+            for i, r in enumerate(rewards):
+                telemetry.emit("trial", f"fold{fold}", fold=fold,
+                               trial=t_base + i, reward=float(r),
+                               quarantined=round_failure is not None)
             fold_trials.extend(
                 (p, r) if round_failure is None else (p, r, round_failure)
                 for p, r in zip(proposals, rewards))
@@ -1400,7 +1439,7 @@ def search_policies(
             "evaluations — see search_result.json['quarantined_trials']",
             len(quarantined))
     result["device_secs_phase2"] = result["tpu_secs_phase2"] = (
-        (time.time() - t0) * mesh.size)
+        (wall() - t0) * mesh.size)
     # async-pipeline accounting (+ the dispatch-gap evidence whenever
     # the trace is armed — FAA_PIPELINE_TRACE=1 captures the serial
     # baseline the pipeline bench compares against).  In overlap mode
@@ -1474,7 +1513,7 @@ def search_policies(
         result["resilience"]["watchdog"] = wd.stats()
         result["compile_cache"] = compile_cache_stats()
         result["final_policy_set_pre_audit_size"] = len(final_policy_set)
-        result["elapsed_total"] = time.time() - watch["start"]
+        result["elapsed_total"] = wall() - watch["start"]
         _write_json_atomic(
             os.path.join(save_dir, "search_result.json"),
             {k: v for k, v in result.items()
@@ -1486,7 +1525,7 @@ def search_policies(
     # identical candidate folds/floors, per-arm timing + record file —
     # the searched-vs-random comparison stays fair by construction
     def _audited(policy_set, cache_name: str, secs_key: str):
-        t0 = time.time()
+        t0 = wall()
         apath = os.path.join(save_dir, cache_name)
         cached = None
         if resume and os.path.exists(apath):
@@ -1504,7 +1543,7 @@ def search_policies(
             quality_floor=fold_quality_floor,
             cached_audit=cached,
         )
-        result[f"device_secs_{secs_key}"] = (time.time() - t0) * mesh.size
+        result[f"device_secs_{secs_key}"] = (wall() - t0) * mesh.size
         result[f"tpu_secs_{secs_key}"] = result[f"device_secs_{secs_key}"]
         _write_json_atomic(apath, audit)
         return kept, audit
@@ -1580,7 +1619,7 @@ def search_policies(
         "search done: %d sub-policies; phase1 %.1f TPU-s, phase2 %.1f TPU-s",
         len(final_policy_set), result["tpu_secs_phase1"], result["tpu_secs_phase2"],
     )
-    result["elapsed_total"] = time.time() - watch["start"]
+    result["elapsed_total"] = wall() - watch["start"]
     return result
 
 

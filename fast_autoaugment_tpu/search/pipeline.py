@@ -59,11 +59,13 @@ from typing import Callable
 
 import numpy as np
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.core.resilience import (
     DispatchHungError,
     PreemptedError,
     preemption_requested,
 )
+from fast_autoaugment_tpu.core.telemetry import wall
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = ["DispatchTrace", "replay_trial_log", "run_fold_pipeline",
@@ -469,6 +471,11 @@ def run_fold_pipeline(
                        "error": f"{type(payload).__name__}: {payload}"}
         for tid, r in zip(rnd.ids, rewards):
             tpe.tell(tid, r)
+            # journal evidence (no-op with telemetry off): one typed
+            # event per trial told, in trial-id order like the log
+            telemetry.emit("trial", f"fold{fold}", fold=fold, trial=tid,
+                           reward=float(r),
+                           quarantined=failure is not None)
         fold_trials.extend(
             (p, r) if failure is None else (p, r, failure)
             for p, r in zip(rnd.proposals, rewards))
@@ -594,7 +601,8 @@ def run_overlapped_phases(
         for f in fold_list:
             if stop.is_set():
                 return
-            t0 = time.time()
+            t0 = wall()
+            t0m = telemetry.mono()
             try:
                 phase1_fn(f)
             except BaseException as e:
@@ -602,10 +610,12 @@ def run_overlapped_phases(
                     trainer_error.append(e)
                     cond.notify_all()
                 return
+            telemetry.phase_event(f"phase1-fold{f}", t0m, telemetry.mono(),
+                                  fold=int(f), lane="phase1")
             with cond:
                 timeline["phase1"][str(f)] = {"start": t0,
-                                              "end": time.time()}
-                ready[f] = time.time()
+                                              "end": wall()}
+                ready[f] = wall()
                 cond.notify_all()
         with cond:
             cond.notify_all()
@@ -620,9 +630,12 @@ def run_overlapped_phases(
                     cond.wait(timeout=poll_sec)
                 if trainer_error:
                     raise trainer_error[0]
-            t0 = time.time()
+            t0 = wall()
+            t0m = telemetry.mono()
             phase2_fn(f)
-            timeline["phase2"][str(f)] = {"start": t0, "end": time.time()}
+            telemetry.phase_event(f"phase2-fold{f}", t0m, telemetry.mono(),
+                                  fold=int(f), lane="phase2")
+            timeline["phase2"][str(f)] = {"start": t0, "end": wall()}
     except BaseException as e:
         stop.set()
         if isinstance(e, PreemptedError):

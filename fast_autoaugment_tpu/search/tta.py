@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.core.compilecache import seam_jit
 from fast_autoaugment_tpu.core.metrics import Accumulator
 from fast_autoaugment_tpu.core.watchdog import dispatch_enqueue_guard
@@ -266,12 +267,13 @@ def eval_tta(tta_step, params, batch_stats, batches, policy, key,
     monotonic timestamps — the per-dispatch evidence behind the
     pipeline bench's gap histogram.  Tracing forces a per-batch
     ``block_until_ready`` (the tiny output scalars are pulled to the
-    host right after anyway), so it never changes values."""
-    import time as _time
-
+    host right after anyway), so it never changes values.  Every
+    dispatch window also feeds the telemetry span seam
+    (``core/telemetry.py::record_dispatch``, label ``tta``) — registry
+    histogram always, journal event when ``--telemetry`` is armed."""
     acc = Accumulator()
     for i, batch in enumerate(batches):
-        t0 = _time.monotonic() if trace is not None else 0.0
+        t0 = telemetry.mono()
         with dispatch_enqueue_guard():  # async pipeline: one enqueue
             out = tta_step(             # order on every device queue
                 params, batch_stats, batch["x"], batch["y"], batch["m"],
@@ -279,7 +281,12 @@ def eval_tta(tta_step, params, batch_stats, batches, policy, key,
             )
         if trace is not None:
             out = jax.block_until_ready(out)
-            trace(t0, _time.monotonic())
+            t1 = telemetry.mono()
+            trace(t0, t1)
+        else:
+            t1 = telemetry.mono()
+        telemetry.record_dispatch("tta", t0, t1,
+                                  blocking=trace is not None)
         acc.add_dict(out)
     cnt = acc["cnt"]
     return {
@@ -305,12 +312,11 @@ def eval_tta_batched(tta_step_k, params, batch_stats, batches, policies,
     (the sequential loop pays it K times).  `trace(t0, t1)` (optional)
     records each dispatch's start/end monotonic timestamps (the
     per-batch host sync already bounds the dispatch, so tracing adds
-    two clock reads and nothing else)."""
-    import time as _time
-
+    two clock reads and nothing else).  Each dispatch window also feeds
+    the telemetry span seam (label ``tta_batched``)."""
     sums: dict[str, np.ndarray] | None = None
     for i, batch in enumerate(batches):
-        t0 = _time.monotonic() if trace is not None else 0.0
+        t0 = telemetry.mono()
         batch_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, i))(keys)
         with dispatch_enqueue_guard():
             out = tta_step_k(
@@ -321,8 +327,10 @@ def eval_tta_batched(tta_step_k, params, batch_stats, batches, policies,
         # f32 additions eval_tta's Accumulator performs on device, so
         # batched == sequential holds bit-for-bit across batches too
         out = {k: np.asarray(v) for k, v in out.items()}
+        t1 = telemetry.mono()
         if trace is not None:
-            trace(t0, _time.monotonic())
+            trace(t0, t1)
+        telemetry.record_dispatch("tta_batched", t0, t1, blocking=True)
         sums = out if sums is None else {
             k: sums[k] + out[k] for k in sums
         }

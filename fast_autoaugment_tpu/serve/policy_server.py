@@ -56,7 +56,9 @@ from typing import Sequence
 
 import numpy as np
 
+from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.core.resilience import CircuitBreaker, CircuitOpenError
+from fast_autoaugment_tpu.core.telemetry import mono
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = ["AotPolicyApplier", "PolicyServer", "ServeError",
@@ -69,6 +71,11 @@ logger = get_logger("faa_tpu.serve")
 #: padded batch shapes the applier AOT-compiles by default: powers of
 #: four-ish so padding waste stays < 4x at every load level
 DEFAULT_SHAPES = (1, 8, 32, 128)
+
+#: per-process server index: labels each PolicyServer's registry
+#: counters so multiple instances (tests, embedders) never share counts
+_SERVER_SEQ = 0
+_SERVER_SEQ_LOCK = threading.Lock()
 
 
 class ServeError(RuntimeError):
@@ -323,7 +330,7 @@ class DeadlineExpiredError(ServeError):
 class _Pending:
     """One in-flight request: `n` images, completion event, result or
     error, submit/done walls for the latency record, and an optional
-    absolute deadline (``time.perf_counter()`` seconds)."""
+    absolute deadline (``mono()`` seconds)."""
 
     __slots__ = ("images", "keys", "event", "result", "error",
                  "t_submit", "t_done", "deadline")
@@ -335,7 +342,7 @@ class _Pending:
         self.event = threading.Event()
         self.result: np.ndarray | None = None
         self.error: BaseException | None = None
-        self.t_submit = time.perf_counter()
+        self.t_submit = mono()
         self.t_done = 0.0
         self.deadline = deadline
 
@@ -349,7 +356,7 @@ class _Pending:
     def expired(self, now: float | None = None) -> bool:
         if self.deadline is None:
             return False
-        return (time.perf_counter() if now is None else now) >= self.deadline
+        return (mono() if now is None else now) >= self.deadline
 
 
 class _RequestQueue:
@@ -372,13 +379,29 @@ class _RequestQueue:
     """
 
     def __init__(self, depth: int, *, lifo_depth: int = 0,
-                 lifo_age_ms: float = 0.0):
+                 lifo_age_ms: float = 0.0, lifo_counter=None):
         self.depth = int(depth)
         self.lifo_depth = int(lifo_depth)
         self.lifo_age_ms = float(lifo_age_ms)
         self._items: collections.deque[_Pending] = collections.deque()
         self._cond = threading.Condition()
-        self.lifo_takes = 0  # takes served newest-first (stats)
+        # takes served newest-first: a telemetry registry counter when
+        # the owning server provides one (the /stats and /metrics views
+        # then read the SAME number), else a local int
+        self._lifo_counter = lifo_counter
+        self._lifo_takes_local = 0
+
+    @property
+    def lifo_takes(self) -> int:
+        if self._lifo_counter is not None:
+            return int(self._lifo_counter.value)
+        return self._lifo_takes_local
+
+    def _count_lifo_take(self) -> None:
+        if self._lifo_counter is not None:
+            self._lifo_counter.inc()
+        else:
+            self._lifo_takes_local += 1
 
     def __len__(self) -> int:
         with self._cond:
@@ -400,7 +423,7 @@ class _RequestQueue:
         if self.lifo_depth > 0 and len(self._items) >= self.lifo_depth:
             return True
         if self.lifo_age_ms > 0 and self._items:
-            oldest_age = time.perf_counter() - self._items[0].t_submit
+            oldest_age = mono() - self._items[0].t_submit
             if oldest_age * 1e3 >= self.lifo_age_ms:
                 return True
         return False
@@ -414,7 +437,7 @@ class _RequestQueue:
             if not self._items:
                 return None
             if self._lifo_active():
-                self.lifo_takes += 1
+                self._count_lifo_take()
                 return self._items.pop()
             return self._items.popleft()
 
@@ -483,8 +506,39 @@ class PolicyServer:
                 f"shape {applier.max_batch}")
         self.max_wait_ms = float(max_wait_ms)
         self.queue_depth = int(queue_depth)
+        # robustness counters live in the process-wide telemetry
+        # registry (core/telemetry.py): /stats, the bench JSON and a
+        # Prometheus /metrics scrape all read the SAME counters the hot
+        # path bumps (one source of truth; equality pinned by tests).
+        # Each server instance gets its own label so per-instance stats
+        # stay exact when tests build many servers in one process.
+        with _SERVER_SEQ_LOCK:
+            global _SERVER_SEQ
+            self._server_id = str(_SERVER_SEQ)
+            _SERVER_SEQ += 1
+        reg = telemetry.registry()
+
+        def _ctr(name: str) -> telemetry.Counter:
+            return reg.counter(
+                "faa_serve_robustness_total",
+                "serving robustness counters (admission/shed/breaker/"
+                "reload)", counter=name, server=self._server_id)
+
+        self._ctr = {name: _ctr(name) for name in (
+            "admitted", "shed_overload", "shed_breaker", "shed_stopped",
+            "expired", "deadline_misses", "lifo_takes", "reloads")}
+        self._dispatches_ctr = reg.counter(
+            "faa_serve_dispatches_total", "coalesced device dispatches",
+            server=self._server_id)
+        self._requests_ctr = reg.counter(
+            "faa_serve_requests_total", "requests served",
+            server=self._server_id)
+        self._images_ctr = reg.counter(
+            "faa_serve_images_total", "images served",
+            server=self._server_id)
         self._q = _RequestQueue(self.queue_depth, lifo_depth=lifo_depth,
-                                lifo_age_ms=lifo_age_ms)
+                                lifo_age_ms=lifo_age_ms,
+                                lifo_counter=self._ctr["lifo_takes"])
         self._carry: _Pending | None = None
         self._stop = threading.Event()
         # admission gate: set by stop() AND begin_drain() — a submit
@@ -498,24 +552,16 @@ class PolicyServer:
                                     else float(default_deadline_ms))
         self.dispatch_timeout_s = float(dispatch_timeout_s)
         self.breaker = CircuitBreaker(threshold=breaker_threshold,
-                                      cooldown_s=breaker_cooldown_s)
+                                      cooldown_s=breaker_cooldown_s,
+                                      name=f"serve{self._server_id}")
         #: grace past a request's deadline that result() still waits —
         #: covers the shed pass delivering the typed error
         self.deadline_grace_s = 1.0
-        # serving accounting for the bench/stats endpoints
-        self.dispatches = 0
-        self.requests = 0
-        self.images_served = 0
+        # serving accounting for the bench/stats endpoints (volume
+        # counters also live in the registry; the wall/batch lists stay
+        # local — they feed percentile math, not counters)
         self.batch_sizes: list[int] = []
         self.dispatch_walls: list[float] = []
-        # robustness accounting (admission / shed / breaker / reload)
-        self.admitted = 0
-        self.shed_overload = 0
-        self.shed_breaker = 0
-        self.shed_stopped = 0
-        self.expired = 0
-        self.deadline_misses = 0
-        self.reloads = 0
         self._dispatch_attempts = 0  # incl. fast-fails + injected errors
         self._wall_ema: float | None = None
 
@@ -610,13 +656,15 @@ class PolicyServer:
                 f"request of {n} images exceeds max_batch "
                 f"{self.max_batch} — split client-side")
         if self._closed.is_set():
-            with self._lock:
-                self.shed_stopped += 1
+            self._ctr["shed_stopped"].inc()
+            telemetry.emit("shed", f"serve{self._server_id}",
+                           reason="stopped", n=int(n))
             raise ServerStoppedError(
                 "server is stopped/draining — not admitting requests")
         if self.breaker.is_open():
-            with self._lock:
-                self.shed_breaker += 1
+            self._ctr["shed_breaker"].inc()
+            telemetry.emit("shed", f"serve{self._server_id}",
+                           reason="breaker_open", n=int(n))
             raise CircuitOpenError(
                 "circuit breaker open — backend failing, not admitting "
                 "requests", retry_after_s=self.breaker.retry_after_s())
@@ -627,16 +675,16 @@ class PolicyServer:
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         deadline = (None if deadline_ms is None
-                    else time.perf_counter() + float(deadline_ms) / 1e3)
+                    else mono() + float(deadline_ms) / 1e3)
         pending = _Pending(images, keys, deadline)
         if not self._q.offer(pending):
-            with self._lock:
-                self.shed_overload += 1
+            self._ctr["shed_overload"].inc()
+            telemetry.emit("shed", f"serve{self._server_id}",
+                           reason="overload", n=int(n))
             raise ServerOverloadedError(
                 f"queue full ({self.queue_depth} requests) — shedding",
                 retry_after_s=max(0.05, self.max_wait_ms / 1e3))
-        with self._lock:
-            self.admitted += 1
+        self._ctr["admitted"].inc()
         return pending
 
     def result(self, pending: _Pending, timeout: float = 60.0) -> np.ndarray:
@@ -645,7 +693,7 @@ class PolicyServer:
         timeout is bounded by the deadline plus a small grace for the
         shed pass to deliver the typed error."""
         if pending.deadline is not None:
-            left = pending.deadline - time.perf_counter()
+            left = pending.deadline - mono()
             timeout = min(timeout, max(0.0, left) + self.deadline_grace_s)
         if not pending.event.wait(timeout=timeout):
             raise TimeoutError(
@@ -697,8 +745,10 @@ class PolicyServer:
                 f"is below the server's max_batch {self.max_batch}")
         with self._lock:
             self.applier = new_applier
-            self.reloads += 1
+            self._ctr["reloads"].inc()
             n = self.reloads
+        telemetry.emit("reload", f"serve{self._server_id}", reloads=n,
+                       num_sub=new_applier.num_sub)
         logger.info("hot reload #%d: applier swapped (%d sub-policies)",
                     n, new_applier.num_sub)
         return {"reloads": n, "num_sub": new_applier.num_sub}
@@ -720,8 +770,9 @@ class PolicyServer:
             f"({p.n} images) — request shed before dispatch")
         p.t_done = now
         p.event.set()
-        with self._lock:
-            self.expired += 1
+        self._ctr["expired"].inc()
+        telemetry.emit("shed", f"serve{self._server_id}",
+                       reason="deadline_expired", n=int(p.n))
 
     def _collect(self, first: _Pending) -> list[_Pending]:
         """Coalesce: up to ``max_batch`` images or ``max_wait_ms`` after
@@ -729,21 +780,21 @@ class PolicyServer:
         shed as they are encountered and never join the batch."""
         batch: list[_Pending] = []
         count = 0
-        now = time.perf_counter()
+        now = mono()
         if first.expired(now):
             self._shed(first, now)
         else:
             batch.append(first)
             count = first.n
-        deadline = time.perf_counter() + self.max_wait_ms / 1e3
+        deadline = mono() + self.max_wait_ms / 1e3
         while count < self.max_batch:
-            remaining = deadline - time.perf_counter()
+            remaining = deadline - mono()
             if remaining <= 0:
                 break
             nxt = self._q.take(timeout=remaining)
             if nxt is None:
                 break
-            now = time.perf_counter()
+            now = mono()
             if nxt.expired(now):
                 self._shed(nxt, now)
                 continue
@@ -757,7 +808,7 @@ class PolicyServer:
         return batch
 
     def _fail_batch(self, batch: list[_Pending], err: BaseException) -> None:
-        done = time.perf_counter()
+        done = mono()
         for p in batch:
             p.error = err
             p.t_done = done
@@ -781,8 +832,9 @@ class PolicyServer:
             err = CircuitOpenError(
                 "circuit breaker open — dispatch failed fast",
                 retry_after_s=self.breaker.retry_after_s())
-            with self._lock:
-                self.shed_breaker += len(batch)
+            self._ctr["shed_breaker"].inc(len(batch))
+            telemetry.emit("shed", f"serve{self._server_id}",
+                           reason="breaker_open", n=len(batch))
             self._fail_batch(batch, err)
             return
         images = np.concatenate([p.images for p in batch])
@@ -792,7 +844,7 @@ class PolicyServer:
             # one program key per dispatch, derived server-side
             keys = self._auto_keys(1)[0]
         fault = self._injected_fault()
-        t0 = time.perf_counter()
+        t0 = mono()
         try:
             if fault is not None and fault[0] == "error":
                 raise ServeError(
@@ -808,7 +860,7 @@ class PolicyServer:
             self.breaker.record_failure()
             self._fail_batch(batch, e)
             return
-        wall = time.perf_counter() - t0
+        wall = mono() - t0
         if self.dispatch_timeout_s > 0 and wall > self.dispatch_timeout_s:
             # a straggler past the dispatch budget counts toward the
             # breaker even though its results are delivered — repeated
@@ -820,7 +872,7 @@ class PolicyServer:
         else:
             self.breaker.record_success()
         lo = 0
-        done = time.perf_counter()
+        done = mono()
         misses = 0
         for p in batch:
             p.result = out[lo:lo + p.n]
@@ -829,13 +881,19 @@ class PolicyServer:
             if p.deadline is not None and done > p.deadline:
                 misses += 1
             p.event.set()
+        self._dispatches_ctr.inc()
+        self._requests_ctr.inc(len(batch))
+        self._images_ctr.inc(int(images.shape[0]))
+        if misses:
+            self._ctr["deadline_misses"].inc(misses)
         with self._lock:
-            self.dispatches += 1
-            self.requests += len(batch)
-            self.images_served += images.shape[0]
             self.batch_sizes.append(images.shape[0])
             self.dispatch_walls.append(wall)
-            self.deadline_misses += misses
+        # the serve arm of the span seam: same record shape as the
+        # trainer/TTA dispatch windows (core/telemetry.py)
+        telemetry.record_dispatch("serve_dispatch", t0, done,
+                                  batch=int(images.shape[0]),
+                                  requests=len(batch))
         self._wall_ema = (wall if self._wall_ema is None
                           else 0.2 * wall + 0.8 * self._wall_ema)
 
@@ -854,47 +912,93 @@ class PolicyServer:
         self._carry = None
         leftovers.extend(self._q.drain())
         if leftovers:
-            with self._lock:
-                self.shed_stopped += len(leftovers)
+            self._ctr["shed_stopped"].inc(len(leftovers))
+            telemetry.emit("shed", f"serve{self._server_id}",
+                           reason="stopped", n=len(leftovers))
         for p in leftovers:
             p.error = ServerStoppedError("server stopped")
-            p.t_done = time.perf_counter()
+            p.t_done = mono()
             p.event.set()
 
     # ----------------------------------------------------------- stats
+
+    # Read-only views onto the registry counters: the historical
+    # attribute surface (tests, benches) keeps working, and every
+    # reader — /stats, bench JSON, a Prometheus /metrics scrape — sees
+    # the ONE number the hot path bumped.
+    @property
+    def admitted(self) -> int:
+        return int(self._ctr["admitted"].value)
+
+    @property
+    def shed_overload(self) -> int:
+        return int(self._ctr["shed_overload"].value)
+
+    @property
+    def shed_breaker(self) -> int:
+        return int(self._ctr["shed_breaker"].value)
+
+    @property
+    def shed_stopped(self) -> int:
+        return int(self._ctr["shed_stopped"].value)
+
+    @property
+    def expired(self) -> int:
+        return int(self._ctr["expired"].value)
+
+    @property
+    def deadline_misses(self) -> int:
+        return int(self._ctr["deadline_misses"].value)
+
+    @property
+    def reloads(self) -> int:
+        return int(self._ctr["reloads"].value)
+
+    @property
+    def dispatches(self) -> int:
+        return int(self._dispatches_ctr.value)
+
+    @property
+    def requests(self) -> int:
+        return int(self._requests_ctr.value)
+
+    @property
+    def images_served(self) -> int:
+        return int(self._images_ctr.value)
 
     def stats(self) -> dict:
         with self._lock:
             sizes = list(self.batch_sizes)
             walls = list(self.dispatch_walls)
-            out = {
-                "dispatches": self.dispatches,
-                "requests": self.requests,
-                "images_served": self.images_served,
-                "max_batch": self.max_batch,
-                "max_wait_ms": self.max_wait_ms,
-                "dispatch": self.applier.dispatch,
-                "shapes": list(self.applier.shapes),
-                # robustness counters (admission / shed / breaker /
-                # reload) — stamped into /stats and the bench JSON
-                "admission": {
-                    "queue_depth": self.queue_depth,
-                    "queued": len(self._q),
-                    "admitted": self.admitted,
-                    "shed_overload": self.shed_overload,
-                    "shed_breaker": self.shed_breaker,
-                    "shed_stopped": self.shed_stopped,
-                    "expired": self.expired,
-                    "deadline_misses": self.deadline_misses,
-                    "lifo_takes": self._q.lifo_takes,
-                    "lifo_depth": self._q.lifo_depth,
-                    "lifo_age_ms": self._q.lifo_age_ms,
-                    "default_deadline_ms": self.default_deadline_ms,
-                },
-                "breaker": self.breaker.snapshot(),
-                "reloads": self.reloads,
-                "draining": self._closed.is_set(),
-            }
+        out = {
+            "dispatches": self.dispatches,
+            "requests": self.requests,
+            "images_served": self.images_served,
+            "max_batch": self.max_batch,
+            "max_wait_ms": self.max_wait_ms,
+            "dispatch": self.applier.dispatch,
+            "shapes": list(self.applier.shapes),
+            # robustness counters (admission / shed / breaker /
+            # reload) — sourced from the telemetry registry, the same
+            # counters /metrics exports (docs/OBSERVABILITY.md)
+            "admission": {
+                "queue_depth": self.queue_depth,
+                "queued": len(self._q),
+                "admitted": self.admitted,
+                "shed_overload": self.shed_overload,
+                "shed_breaker": self.shed_breaker,
+                "shed_stopped": self.shed_stopped,
+                "expired": self.expired,
+                "deadline_misses": self.deadline_misses,
+                "lifo_takes": self._q.lifo_takes,
+                "lifo_depth": self._q.lifo_depth,
+                "lifo_age_ms": self._q.lifo_age_ms,
+                "default_deadline_ms": self.default_deadline_ms,
+            },
+            "breaker": self.breaker.snapshot(),
+            "reloads": self.reloads,
+            "draining": self._closed.is_set(),
+        }
         if sizes:
             out["mean_batch"] = round(float(np.mean(sizes)), 2)
             out["mean_dispatch_ms"] = round(float(np.mean(walls)) * 1e3, 3)

@@ -56,11 +56,11 @@ import os
 import signal
 import sys
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from fast_autoaugment_tpu.core.telemetry import mono, wall
 from fast_autoaugment_tpu.core.resilience import (
     PREEMPTED_EXIT_CODE,
     CircuitOpenError,
@@ -123,7 +123,7 @@ class ServeState:
         self.exit_code = 0
         self.stop_event = threading.Event()
         self.reload_lock = threading.Lock()
-        self.started_at = time.time()
+        self.started_at = wall()
 
     # ------------------------------------------------------- readiness
 
@@ -151,12 +151,12 @@ class ServeState:
             raise BlockingIOError("a reload is already in progress")
         try:
             spec = spec or self.policy_spec
-            t0 = time.perf_counter()
+            t0 = mono()
             policy = build_policy_tensor(spec)
             applier = self.build_applier(policy)
             info = self.server.swap_applier(applier)
             info.update(policy=spec,
-                        warm_sec=round(time.perf_counter() - t0, 3))
+                        warm_sec=round(mono() - t0, 3))
             logger.info("reload complete: %s", info)
             return info
         finally:
@@ -262,6 +262,16 @@ def make_handler(server, applier, state: ServeState | None = None,
                     str(s): r for s, r in getattr(
                         server.applier, "compile_log", {}).items()}
                 self._send_json(200, stats)
+                return
+            if self.path == "/metrics":
+                # Prometheus text exposition of the process-wide
+                # telemetry registry — the SAME counters /stats reads
+                # (core/telemetry.py; docs/OBSERVABILITY.md)
+                from fast_autoaugment_tpu.core import telemetry
+
+                self._send(200,
+                           telemetry.registry().prometheus_text().encode(),
+                           telemetry.PROMETHEUS_CONTENT_TYPE)
                 return
             self._send_error_json(404, "unknown_path",
                                   f"unknown path {self.path}")
@@ -421,7 +431,7 @@ def _write_beat(path: str, tag: str, done: bool = False) -> None:
     can SIGKILL a wedged serving replica."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
-        json.dump({"owner": tag, "heartbeat": time.time(), "done": done}, fh)
+        json.dump({"owner": tag, "heartbeat": wall(), "done": done}, fh)
     os.replace(tmp, path)
 
 
@@ -482,6 +492,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persistent XLA compilation cache: a restarted "
                         "server deserializes its AOT executables from DIR "
                         "instead of re-lowering them (core/compilecache.py)")
+    p.add_argument("--telemetry", default="off", metavar="{off,DIR}",
+                   help="flight-recorder journal dir (core/telemetry.py): "
+                        "typed dispatch/shed/breaker/reload events with "
+                        "rotation-bounded size, renderable via tools/"
+                        "trace_export.py.  'off' (default) = no journal "
+                        "I/O (still honors an inherited FAA_TELEMETRY); "
+                        "GET /metrics exposes the in-memory registry "
+                        "either way")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8765)
     # ---------------- overload / resilience knobs (defaults = PR-7
@@ -563,6 +581,9 @@ def main(argv=None):
     )
 
     configure_compile_cache(args.compile_cache)
+    from fast_autoaugment_tpu.core.telemetry import configure_telemetry
+
+    configure_telemetry(args.telemetry)
     shapes = tuple(int(s) for s in str(args.shapes).split(",") if s)
     watchdog = resolve_watchdog(args.watchdog)
 

@@ -42,6 +42,8 @@ from fast_autoaugment_tpu.core.resilience import (
     install_signal_handlers,
     preemption_requested,
 )
+from fast_autoaugment_tpu.core import telemetry
+from fast_autoaugment_tpu.core.telemetry import wall
 from fast_autoaugment_tpu.core.watchdog import (
     dispatch_enqueue_guard,
     resolve_watchdog,
@@ -160,6 +162,7 @@ def _run_replay_eval(replay_step, params, batch_stats, groups,
     deadlock was first observed exactly here, in eval)."""
     acc = Accumulator()
     for g in groups:
+        t0 = telemetry.mono()
         if wd is not None and wd.enabled:
             out = wd.run("replay_eval", replay_step, params, batch_stats,
                          g["x"], g["y"], g["m"])
@@ -167,34 +170,47 @@ def _run_replay_eval(replay_step, params, batch_stats, groups,
             with dispatch_enqueue_guard():
                 out = replay_step(params, batch_stats, g["x"], g["y"],
                                   g["m"])
+        telemetry.record_dispatch("replay_eval", t0, telemetry.mono())
         acc.add_dict(out)
     return acc.normalize()
 
 
 def _monitored_dispatch(wd, label: str, fi, step: int, fn, *args):
-    """One device dispatch through the watchdog seam.
+    """One device dispatch through the watchdog + telemetry span seam.
 
     With the watchdog off and no injected fault this is EXACTLY the
-    historical direct call — async dispatch, no per-dispatch block.
+    historical direct call — async dispatch, no per-dispatch block (the
+    span then times the ENQUEUE window, not device completion; the
+    monitored path times the full blocking wall).
     With the watchdog on (or a ``hang``/``slow`` fault pinned at this
     step) the call runs deadline-guarded in a worker thread, blocking
     on completion; that serializes the dispatch pipeline (wall only —
     values are unchanged), which is why ``--watchdog`` defaults off.
     A fired deadline raises the typed ``DispatchHungError`` (exit-77
-    recovery — core/watchdog.py)."""
+    recovery — core/watchdog.py).  Every path records the window
+    through :func:`~fast_autoaugment_tpu.core.telemetry.record_dispatch`
+    — the same span seam the TTA/audit and serve dispatches use."""
     inject = fi.dispatch_delay(step) if fi is not None else None
     if inject is None and not wd.enabled:
         # enqueue-order serialization (async pipeline only; no-op
         # otherwise) — completion stays async, the historical path
+        t0 = telemetry.mono()
         with dispatch_enqueue_guard():
-            return fn(*args)
+            out = fn(*args)
+        telemetry.record_dispatch(label, t0, telemetry.mono(), step=step,
+                                  blocking=False)
+        return out
     delay = 0.0
     if inject is not None:
         kind, val = inject
         # slow = straggler at F x the label's observed EMA (F seconds
         # before any observation); hang = forever
         delay = val if kind == "hang" else val * (wd.ema(label) or 1.0)
-    return wd.run(label, fn, *args, inject_delay=delay)
+    t0 = telemetry.mono()
+    out = wd.run(label, fn, *args, inject_delay=delay)
+    telemetry.record_dispatch(label, t0, telemetry.mono(), step=step,
+                              blocking=True)
+    return out
 
 
 def _beat(heartbeat) -> None:
@@ -595,7 +611,7 @@ def train_and_eval(
         state = jax.device_put(state, replicated(mesh))
         rng = jax.device_put(rng, replicated(mesh))
 
-    t_start = time.time()
+    t_start = wall()
     pol = policy if policy is not None else jnp.zeros((1, 1, 3), jnp.float32)
     if train_cache is not None:
         pol = jax.device_put(pol, replicated(mesh))
@@ -861,7 +877,7 @@ def train_and_eval(
             raise PreemptedError(f"preempted after epoch {epoch}")
         epoch += 1
 
-    result["elapsed_sec"] = time.time() - t_start
+    result["elapsed_sec"] = wall() - t_start
     # compile-tax evidence (hit/miss counts + per-label first-call
     # seconds through the seam): a resumed/warm process proves here
     # that it reached its first step in seconds, not minutes
@@ -956,7 +972,7 @@ def train_folds_stacked(
         mesh = make_fold_mesh(num_folds)
     data_size = mesh.shape["data"]
     is_master = jax.process_index() == 0
-    t_start = time.time()
+    t_start = wall()
 
     dataset_name = conf["dataset"]
     num_classes = num_class(dataset_name)
@@ -1359,7 +1375,7 @@ def train_folds_stacked(
                 "exit %d means 'resume me'", epoch, PREEMPTED_EXIT_CODE)
             raise PreemptedError(f"stacked preempted after epoch {epoch}")
 
-    elapsed = time.time() - t_start
+    elapsed = wall() - t_start
     cc = compile_cache_stats()
     logger.info("stacked: compile cache dir=%s hits=%d misses=%d "
                 "first_step_secs=%.3f", cc["dir"], cc["hits"], cc["misses"],

@@ -40,13 +40,25 @@ def trace(logdir: str | None):
 
 class StepTimer:
     """Steady-state step timing: skips `warmup` steps (compilation),
-    then tracks mean step time and throughput."""
+    then tracks mean step time and throughput.
 
-    def __init__(self, warmup: int = 3):
+    With a `registry` (``core/telemetry.py``) and a `name`, every
+    steady-state stop mirrors ``faa_step_seconds{timer=name}`` into the
+    shared metrics registry — the same numbers any ``/metrics`` scrape
+    or bench stamp reads."""
+
+    def __init__(self, warmup: int = 3, *, name: str | None = None,
+                 registry=None):
         self.warmup = warmup
         self.count = 0
         self.total = 0.0
         self._last = None
+        self._name = name
+        self._hist = None
+        if registry is not None and name is not None:
+            self._hist = registry.histogram(
+                "faa_step_seconds", "steady-state per-step wall seconds",
+                timer=name)
 
     def start(self):
         self._last = time.perf_counter()
@@ -57,6 +69,8 @@ class StepTimer:
         if self.count > self.warmup:
             self.total += dt
             self._items = getattr(self, "_items", 0) + items
+            if self._hist is not None:
+                self._hist.observe(dt)
         return dt
 
     @property
@@ -74,9 +88,18 @@ class StepTimer:
 
 class PhaseStopwatch:
     """Named-phase wall + device-seconds ledger (the reference's
-    pystopwatch2 + GPU-hours accounting)."""
+    pystopwatch2 + GPU-hours accounting).
 
-    def __init__(self, device_count: int | None = None):
+    With a `registry` (``core/telemetry.py``), every :meth:`stop`
+    mirrors the accumulated totals into the shared metrics registry —
+    ``faa_phase_wall_seconds{phase=name}`` and
+    ``faa_phase_device_seconds{phase=name}`` gauges — so the
+    device-hours the artifacts stamp and the numbers a ``/metrics``
+    scrape reports come from ONE ledger (the
+    ``device_secs_phase1_per_fold`` identity in ``search/driver.py`` is
+    pinned to this class by tests)."""
+
+    def __init__(self, device_count: int | None = None, *, registry=None):
         # which backend these device-seconds were measured on: a ledger
         # without provenance reads CPU wall-time as accelerator-hours
         # (VERDICT r4 weak 5).  Only queried when jax must be touched
@@ -94,6 +117,7 @@ class PhaseStopwatch:
             self.device_kind = "unspecified"
         self.phases: dict[str, float] = {}
         self._open: dict[str, float] = {}
+        self._registry = registry
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -109,6 +133,16 @@ class PhaseStopwatch:
     def stop(self, name: str):
         if name in self._open:
             self.phases[name] = self.phases.get(name, 0.0) + (time.time() - self._open.pop(name))
+            if self._registry is not None:
+                w = self.phases[name]
+                self._registry.gauge(
+                    "faa_phase_wall_seconds",
+                    "accumulated wall seconds per named phase",
+                    phase=name).set(w)
+                self._registry.gauge(
+                    "faa_phase_device_seconds",
+                    "accumulated wall x device_count per named phase",
+                    phase=name).set(w * self.device_count)
 
     def wall_seconds(self, name: str) -> float:
         return self.phases.get(name, 0.0)

@@ -320,6 +320,50 @@ def test_r7_robust_allow_suppression():
     assert "R7" not in _rules(check_source(src, SEARCH_SCOPE))
 
 
+# ------------------------------------------------------------------ R8
+
+
+def test_r8_raw_clocks_flagged_in_hot_paths():
+    src = "import time\nt0 = time.time()\nt1 = time.perf_counter()\n"
+    for scope in (TRAIN_SCOPE, SEARCH_SCOPE, SERVE_SCOPE):
+        assert _rules(check_source(src, scope)).count("R8") == 2, scope
+
+
+def test_r8_import_alias_form_flagged():
+    src = "from time import time, perf_counter\n"
+    assert _rules(check_source(src, TRAIN_SCOPE)).count("R8") == 2
+    # importing only sleep/monotonic is fine
+    assert not check_source("from time import sleep, monotonic\n",
+                            TRAIN_SCOPE)
+
+
+def test_r8_monotonic_and_sleep_not_flagged():
+    # deadline plumbing and waits are not timing evidence
+    src = "import time\nd = time.monotonic()\ntime.sleep(0.1)\n"
+    assert "R8" not in _rules(check_source(src, SERVE_SCOPE))
+
+
+def test_r8_seam_calls_not_flagged():
+    src = ("from fast_autoaugment_tpu.core.telemetry import mono, wall\n"
+           "t0 = mono()\nw = wall()\n")
+    assert not check_source(src, TRAIN_SCOPE)
+
+
+def test_r8_out_of_scope_dirs_not_flagged():
+    # core/ and utils/ ARE the seam; launch/ heartbeats are protocol
+    # stamps, not measurements
+    src = "import time\nt = time.time()\n"
+    for scope in (OUT_SCOPE, "fast_autoaugment_tpu/core/x.py",
+                  "fast_autoaugment_tpu/launch/x.py"):
+        assert "R8" not in _rules(check_source(src, scope)), scope
+
+
+def test_r8_robust_allow_suppression():
+    src = ("import time\n"
+           "t = time.time()  # robust: allow — protocol stamp\n")
+    assert "R8" not in _rules(check_source(src, SEARCH_SCOPE))
+
+
 def test_repo_is_clean():
     """The live gate: the package must hold the discipline the
     resilience subsystem depends on (make lint-robust)."""

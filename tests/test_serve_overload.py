@@ -585,6 +585,43 @@ def test_http_stats_carries_robustness_counters():
         srv.stop()
 
 
+def test_http_metrics_scrape_prometheus_exposition():
+    """GET /metrics returns every serve_robustness counter in
+    Prometheus text format, and the scraped values match /stats — one
+    registry behind both views (core/telemetry.py)."""
+    srv = PolicyServer(DummyApplier(dispatch="grouped"), queue_depth=1)
+    httpd, port = _start_http(srv)
+    try:
+        srv.submit(_images(1))
+        with pytest.raises(ServerOverloadedError):
+            srv.submit(_images(1))
+        resp, data = _http(port, "GET", "/metrics")
+        text = data.decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "# TYPE faa_serve_robustness_total counter" in text
+        for name in ("admitted", "shed_overload", "shed_breaker",
+                     "shed_stopped", "expired", "deadline_misses",
+                     "lifo_takes", "reloads"):
+            assert f'counter="{name}"' in text, name
+        # scraped values == /stats values for THIS server's label
+        sid = srv._server_id
+        scraped = {}
+        for line in text.splitlines():
+            if line.startswith("faa_serve_robustness_total") \
+                    and f'server="{sid}"' in line:
+                key = line.split('counter="', 1)[1].split('"', 1)[0]
+                scraped[key] = float(line.rsplit(" ", 1)[1])
+        adm = srv.stats()["admission"]
+        assert scraped["admitted"] == adm["admitted"] == 1
+        assert scraped["shed_overload"] == adm["shed_overload"] == 1
+        assert scraped["expired"] == adm["expired"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
 def test_http_reload_not_configured_and_max_inflight():
     srv = PolicyServer(DummyApplier(dispatch="grouped"))
     httpd, port = _start_http(srv, max_inflight=1)

@@ -100,10 +100,9 @@ def main(argv=None):
 
     from bench import (
         arm_compile_cache_from_env,
-        compile_cache_stamp,
         host_contention_stamp,
         refuse_or_flag_contention,
-        watchdog_stamp,
+        telemetry_stamp,
     )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
@@ -180,6 +179,9 @@ def main(argv=None):
           f"{ms_g / args.batch * 1e3:>10.1f}")
     stack[f"grouped_g{g0}_ms_per_batch"] = round(ms_g, 3)
 
+    # unified provenance block (bench.telemetry_stamp): schema_version
+    # + contention + shadow watchdog + compile cache + registry counters
+    # — the per-(mode, G) compile_sec entries above remain raw timings
     print(json.dumps({
         "metric": "aug_images_per_sec",
         "unit": "images/sec",
@@ -191,14 +193,8 @@ def main(argv=None):
         "modes": modes,
         "policy_493": policy493,
         "full_stack": stack,
-        # unified compile stamp (same block as bench.py's JSON line) —
-        # the per-(mode, G) compile_sec entries above remain as raw
-        # timings; this is the comparable hit/miss record
-        "compile_cache": compile_cache_stamp(),
-        "contention": contention,
-        # auto-watchdog deadline the full train-aug dispatch wall
-        # implies (fires=0: unmonitored) — hang-vs-straggler provenance
-        "watchdog": watchdog_stamp([ms / 1e3], label="train_aug_stack"),
+        **telemetry_stamp([ms / 1e3], label="train_aug_stack",
+                          contention=contention),
     }))
 
 

@@ -107,7 +107,11 @@ def main(argv=None) -> int:
     if args.child:
         return child_main(args)
 
-    from bench import host_contention_stamp, refuse_or_flag_contention
+    from bench import (
+        host_contention_stamp,
+        refuse_or_flag_contention,
+        telemetry_stamp,
+    )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
     cache_dir = args.cache_dir or tempfile.mkdtemp(prefix="faa_compile_cache_")
@@ -163,7 +167,10 @@ def main(argv=None) -> int:
         "warm_hits": warm["compile_cache"]["hits"],
         "warm_misses": warm["compile_cache"]["misses"],
         "backend": warm.get("backend"),
-        "contention": contention,
+        # unified provenance block (bench.telemetry_stamp) — the
+        # supervisor process compiles nothing, so its own compile_cache
+        # block is empty; the cold/warm children carry the real stamps
+        **telemetry_stamp(contention=contention),
     }
     print(json.dumps(out))
     return 0

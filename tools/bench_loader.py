@@ -199,9 +199,9 @@ def main(argv=None):
 
     from bench import (
         arm_compile_cache_from_env,
-        compile_cache_stamp,
         host_contention_stamp,
         refuse_or_flag_contention,
+        telemetry_stamp,
     )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
@@ -249,9 +249,9 @@ def main(argv=None):
     # eager-dataset feed paths: host fancy-gather + device_put vs the
     # device-resident cache gather, one comparable JSON line
     gather = bench_gather()
-    gather["contention"] = contention
-    # unified compile stamp (same block as bench.py's JSON line)
-    gather["compile_cache"] = compile_cache_stamp()
+    # unified provenance block (bench.telemetry_stamp): schema_version
+    # + contention + compile cache + registry counters in one schema
+    gather.update(telemetry_stamp(contention=contention))
     print(json.dumps(gather))
 
     if args.report:

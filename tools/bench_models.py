@@ -133,9 +133,9 @@ def main(argv=None):
     from bench import (  # dead-tunnel guard + load provenance (bench.py)
         _ensure_live_backend,
         arm_compile_cache_from_env,
-        compile_cache_stamp,
         host_contention_stamp,
         refuse_or_flag_contention,
+        telemetry_stamp,
     )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
@@ -167,10 +167,10 @@ def main(argv=None):
             row = {"model": name, "error": str(e).splitlines()[0][:200]}
         if cpu_fallback:
             row["backend"] = "cpu-fallback"  # never masquerades as TPU
-        row["contention"] = contention  # busy-host captures stay visible
-        # unified compile stamp (cumulative across the sweep): the
-        # comparable hit/miss record beside the raw compile_s timing
-        row["compile_cache"] = compile_cache_stamp()
+        # unified provenance block (bench.telemetry_stamp) — the
+        # per-model watchdog stamp bench_one computed rides through
+        row.update(telemetry_stamp(contention=contention,
+                                   watchdog=row.get("watchdog")))
         rows.append(row)
         print(json.dumps(row), flush=True)
 

@@ -160,7 +160,11 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="also write the JSON line here")
     args = p.parse_args(argv)
 
-    from bench import host_contention_stamp, refuse_or_flag_contention
+    from bench import (
+        host_contention_stamp,
+        refuse_or_flag_contention,
+        telemetry_stamp,
+    )
     from bench_tpe import bench_ask_tell_latency
 
     contention = refuse_or_flag_contention(host_contention_stamp())
@@ -169,7 +173,9 @@ def main(argv=None):
     workdir = args.workdir or tempfile.mkdtemp(prefix="faa_bench_pipeline_")
     made_temp = args.workdir is None
     record = run_pipeline_bench(args, workdir)
-    record["contention"] = contention
+    # unified provenance block (bench.telemetry_stamp): contention +
+    # compile cache + registry counters in the shared schema
+    record.update(telemetry_stamp(contention=contention))
     # the overlap headroom the async arm hides: host ask/tell latency
     # at this bench's trial batch (same JSON line, per the bench_tpe
     # citation contract)

@@ -339,7 +339,7 @@ def main(argv=None) -> int:
     from bench import (
         host_contention_stamp,
         refuse_or_flag_contention,
-        watchdog_stamp,
+        telemetry_stamp,
     )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
@@ -347,10 +347,7 @@ def main(argv=None) -> int:
     import jax
     import numpy as np
 
-    from fast_autoaugment_tpu.core.compilecache import (
-        compile_cache_stats,
-        configure_compile_cache,
-    )
+    from fast_autoaugment_tpu.core.compilecache import configure_compile_cache
     from fast_autoaugment_tpu.serve.policy_server import (
         AotPolicyApplier,
         PolicyServer,
@@ -405,8 +402,8 @@ def main(argv=None) -> int:
             **sweep,
             "bitwise_match": bitwise,
             "aot_compile_sec_total": round(aot_secs, 3),
-            "compile_cache": compile_cache_stats(),
-            "contention": contention,
+            # unified provenance block (bench.telemetry_stamp)
+            **telemetry_stamp(contention=contention),
         }
         print(json.dumps(out))
         return 0 if bitwise else 4
@@ -436,12 +433,11 @@ def main(argv=None) -> int:
         "bitwise_match": bitwise,
         "aot_compile_sec_total": round(aot_secs, 3),
         "aot_compile": {str(s): r for s, r in applier.compile_log.items()},
-        # unified compile stamp (the block every bench JSON line carries)
-        "compile_cache": compile_cache_stats(),
-        "contention": contention,
-        "watchdog": watchdog_stamp(stats.get("mean_dispatch_ms", 0) and
-                                   [stats["mean_dispatch_ms"] / 1e3] or [],
-                                   label="serve_dispatch"),
+        # unified provenance block (bench.telemetry_stamp): contention +
+        # shadow watchdog + compile cache + registry counters
+        **telemetry_stamp(stats.get("mean_dispatch_ms", 0) and
+                          [stats["mean_dispatch_ms"] / 1e3] or [],
+                          label="serve_dispatch", contention=contention),
     }
     print(json.dumps(out))
     return 0 if bitwise else 4

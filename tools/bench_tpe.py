@@ -196,16 +196,21 @@ def main(argv=None):
     # weak 1): TPE cells are pure-host timing-free quality numbers, but
     # the committed report is still a capture artifact — stamp it, and
     # honor FAA_BENCH_REQUIRE_QUIET=1 like every other bench tool
-    from bench import host_contention_stamp, refuse_or_flag_contention
+    from bench import (
+        host_contention_stamp,
+        refuse_or_flag_contention,
+        telemetry_stamp,
+    )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
     print(f"contention: {json.dumps(contention)}")
 
     # host-side ask/tell latency per K: the overlap-headroom numbers
-    # the async pipeline bench cites (one JSON line, machine-readable)
+    # the async pipeline bench cites (one JSON line, machine-readable;
+    # unified provenance via bench.telemetry_stamp)
     latency = bench_ask_tell_latency(ks=tuple(args.latency_ks))
     print("tpe_latency: " + json.dumps(
-        {"contention": contention, "rows": latency}))
+        {**telemetry_stamp(contention=contention), "rows": latency}))
     for row in latency:
         print(f"  K={row['k']}: ask {row['ask_ms_mean']:.2f} ms "
               f"(p99 {row['ask_ms_p99']:.2f}, "

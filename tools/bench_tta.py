@@ -98,7 +98,7 @@ def main(argv=None) -> int:
     ms_per_call = steady / args.calls * 1e3
     # each call forwards batch x num_policy augmented images
     imgs_per_sec = args.batch * args.num_policy * args.calls / steady
-    from bench import compile_cache_stamp, host_contention_stamp, watchdog_stamp
+    from bench import telemetry_stamp
 
     summary = {
         "backend": platform,
@@ -108,19 +108,14 @@ def main(argv=None) -> int:
         "image": args.image,
         "num_policy": args.num_policy,
         "compile_s": round(compile_s, 2),
-        # unified compile stamp (same block as bench.py's JSON line):
-        # cache hit/miss counts + per-label first-call seconds
-        "compile_cache": compile_cache_stamp(),
         "tta_ms_per_call": round(ms_per_call, 3),
         "tta_images_per_sec": round(imgs_per_sec, 1),
         "unix_time": time.time(),
-        # loadavg/process provenance: a busy-host capture must be
-        # visible in the artifact itself (VERDICT r5 weak 1)
-        "contention": host_contention_stamp(),
-        # the auto-watchdog deadline this TTA dispatch wall implies
-        # (fires=0: unmonitored bench) — hang-vs-straggler provenance
-        "watchdog": watchdog_stamp([ms_per_call / 1e3], label="tta"),
     }
+    # unified provenance block (schema_version + contention + shadow
+    # watchdog + compile cache + telemetry counters) — one helper
+    # across every bench tool (bench.telemetry_stamp)
+    summary.update(telemetry_stamp([ms_per_call / 1e3], label="tta"))
     line = json.dumps(summary)
     print(line)
     if args.out:

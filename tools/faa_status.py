@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""One fleet table from telemetry journals + fleet heartbeats.
+
+    python tools/faa_status.py --dir /shared/run
+    python tools/faa_status.py --dir /shared/run --json
+
+Aggregates, across every host that writes under ``--dir``:
+
+- **flight-recorder journals** (``journal-*.jsonl``,
+  ``core/telemetry.py`` — the CLIs' ``--telemetry DIR`` / the fleet's
+  ``--telemetry``): per-host device busy fraction and dispatch-gap
+  p50/p99 from the ``dispatch`` event windows (union-merged per thread,
+  the ``DispatchTrace`` math), plus watchdog-fire / breaker-fire /
+  shed / preempt counts and the age of the newest event;
+- **fleet/workqueue heartbeats** (``hosts/<owner>.json`` —
+  ``launch/workqueue.py::beat_host`` and ``serve_cli
+  --heartbeat-dir``): alive / done / STALE verdicts against ``--ttl``;
+- **done markers** (``done/<unit>.json``): units finished per host and
+  the reclaimed-unit evidence (``attempt > 1``).
+
+Everything is read-only over shared files — safe against a live fleet,
+host-only (no jax import), and exactly the cross-host view no single
+``search_result.json`` can stamp.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from trace_export import read_journal  # noqa: E402  (sibling tool)
+
+#: event types counted per host in the incident columns
+_COUNTED = ("watchdog_fire", "breaker_fire", "shed", "preempt", "lease")
+
+
+def _merge(windows: list[tuple[float, float]]) -> list[list[float]]:
+    merged: list[list[float]] = []
+    for t0, t1 in sorted(windows):
+        if merged and t0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], t1)
+        else:
+            merged.append([t0, t1])
+    return merged
+
+
+def _percentile(xs: list[float], q: float) -> float | None:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def dispatch_stats(records: list[dict]) -> dict:
+    """Busy-frac + gap percentiles from one host's ``dispatch`` windows
+    (grouped per (pid, tid) — concurrent actors merge per thread, the
+    same union semantics as ``search/pipeline.py::DispatchTrace``)."""
+    lanes: dict[tuple, list[tuple[float, float]]] = {}
+    for r in records:
+        if r.get("type") != "dispatch":
+            continue
+        t0, t1 = r.get("t_mono_start"), r.get("t_mono_end")
+        if isinstance(t0, (int, float)) and isinstance(t1, (int, float)):
+            lanes.setdefault((r.get("pid", 0), r.get("tid", 0)),
+                             []).append((float(t0), float(t1)))
+    busy = span = 0.0
+    gaps: list[float] = []
+    n = 0
+    for windows in lanes.values():
+        merged = _merge(windows)
+        busy += sum(t1 - t0 for t0, t1 in merged)
+        span += merged[-1][1] - merged[0][0]
+        gaps.extend(b[0] - a[1] for a, b in zip(merged, merged[1:]))
+        n += len(windows)
+    p50 = _percentile(gaps, 50)
+    p99 = _percentile(gaps, 99)
+    return {
+        "dispatches": n,
+        "busy_secs": round(busy, 3),
+        "busy_frac": round(busy / span, 4) if span > 0 else None,
+        "gap_p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+        "gap_p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+    }
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def read_heartbeats(root: str) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    hosts_dir = os.path.join(root, "hosts")
+    try:
+        names = sorted(os.listdir(hosts_dir))
+    except OSError:
+        return out
+    for name in names:
+        if name.endswith(".json"):
+            rec = _read_json(os.path.join(hosts_dir, name))
+            if rec and rec.get("owner"):
+                out[str(rec["owner"])] = rec
+    return out
+
+
+def read_done_markers(root: str) -> list[dict]:
+    out: list[dict] = []
+    done_dir = os.path.join(root, "done")
+    try:
+        names = sorted(os.listdir(done_dir))
+    except OSError:
+        return out
+    for name in names:
+        if name.endswith(".json"):
+            rec = _read_json(os.path.join(done_dir, name))
+            if rec:
+                out.append(rec)
+    return out
+
+
+def fleet_status(root: str, ttl: float = 60.0,
+                 now: float | None = None) -> dict:
+    """The aggregated per-host view (JSON-ready)."""
+    now = time.time() if now is None else now
+    journal = read_journal(root)
+    beats = read_heartbeats(root)
+    done = read_done_markers(root)
+
+    by_host: dict[str, list[dict]] = {}
+    for rec in journal:
+        by_host.setdefault(str(rec.get("host")), []).append(rec)
+
+    hosts: dict[str, dict] = {}
+    for name in sorted(set(by_host) | set(beats)):
+        recs = by_host.get(name, [])
+        row = dispatch_stats(recs)
+        for etype in _COUNTED:
+            row[etype + "s"] = sum(1 for r in recs
+                                   if r.get("type") == etype)
+        row["attempts"] = max(
+            [int(r.get("attempt", 1)) for r in recs], default=None)
+        walls = [r["t_wall"] for r in recs
+                 if isinstance(r.get("t_wall"), (int, float))]
+        row["last_event_age_s"] = (round(now - max(walls), 1)
+                                   if walls else None)
+        beat = beats.get(name)
+        if beat is None:
+            row["beat"] = "none"
+        elif beat.get("done"):
+            row["beat"] = "done"
+        else:
+            age = now - float(beat.get("heartbeat", 0.0))
+            row["beat"] = "alive" if age <= ttl else f"STALE {age:.0f}s"
+        row["units_done"] = sum(1 for d in done if d.get("owner") == name)
+        hosts[name] = row
+
+    reclaimed = [
+        {"unit": d.get("unit"), "attempt": int(d.get("attempt", 1)),
+         "finished_by": d.get("owner"),
+         "reclaimed_from": d.get("reclaimed_from")}
+        for d in done if int(d.get("attempt", 1)) > 1
+    ]
+    return {
+        "dir": os.path.abspath(root),
+        "generated_at": now,
+        "ttl_s": ttl,
+        "hosts": hosts,
+        "units_done": len(done),
+        "reclaimed_units": reclaimed,
+        "journal_records": len(journal),
+    }
+
+
+_COLUMNS = (
+    ("beat", "beat"),
+    ("busy_frac", "busy"),
+    ("gap_p50_ms", "gap p50"),
+    ("gap_p99_ms", "gap p99"),
+    ("dispatches", "disp"),
+    ("watchdog_fires", "wd"),
+    ("breaker_fires", "brk"),
+    ("sheds", "shed"),
+    ("preempts", "preempt"),
+    ("units_done", "units"),
+    ("attempts", "att"),
+    ("last_event_age_s", "last ev"),
+)
+
+
+def render_table(status: dict) -> str:
+    rows = [["host"] + [h for _k, h in _COLUMNS]]
+    for name, row in sorted(status["hosts"].items()):
+        rows.append([name] + [
+            "-" if row.get(k) is None else str(row.get(k))
+            for k, _h in _COLUMNS])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    tail = (f"{status['units_done']} unit(s) done, "
+            f"{len(status['reclaimed_units'])} reclaimed, "
+            f"{status['journal_records']} journal record(s)")
+    for rec in status["reclaimed_units"]:
+        tail += (f"\n  reclaimed: {rec['unit']} attempt {rec['attempt']} "
+                 f"finished by {rec['finished_by']} "
+                 f"(from {rec['reclaimed_from']})")
+    return "\n".join(lines) + "\n" + tail
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="aggregate telemetry journals + fleet heartbeats "
+                    "into one per-host status table")
+    p.add_argument("--dir", required=True,
+                   help="the shared dir: --telemetry journals and/or a "
+                        "workqueue/heartbeat layout (hosts/, done/)")
+    p.add_argument("--ttl", type=float, default=60.0,
+                   help="heartbeat staleness bound (the workqueue lease "
+                        "TTL; default 60s)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the aggregate as one JSON object instead "
+                        "of the table")
+    args = p.parse_args(argv)
+
+    status = fleet_status(args.dir, ttl=args.ttl)
+    if not status["hosts"]:
+        print(f"faa_status: nothing under {args.dir} (no journal-*.jsonl, "
+              "no hosts/*.json)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(status))
+    else:
+        print(render_table(status))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,17 @@ R7  **no unbounded blocking in the search pipeline** (``search/``):
     promptly); one untimed wait turns a lost actor into a wedged
     search.  Gated from day one so new pipeline code cannot regress.
 
+R8  **no raw clock reads in the train/search/serve hot paths**: a
+    ``time.time()`` / ``time.perf_counter()`` reference (call, alias,
+    or ``from time import time/perf_counter``) outside the telemetry/
+    profiling seam.  Timing that bypasses ``core/telemetry.py``
+    (``wall()``/``mono()``/``span()``) or ``utils/profiling.py`` is a
+    measurement the registry, the flight-recorder journal and the
+    artifact stamps can never see — exactly the private-schema
+    accounting drift the unified telemetry layer exists to end
+    (docs/OBSERVABILITY.md).  ``time.monotonic``/``time.sleep`` are not
+    timing evidence and stay unflagged.
+
 Suppress a finding (sparingly, with a reason nearby) by putting
 ``robust: allow`` in a comment on the offending line.
 
@@ -106,6 +117,16 @@ SERVE_BLOCKING_DIRS = ("serve",)
 # (search/pipeline.py) threads dispatches concurrently under the same
 # no-thread-parks-forever contract as serving.
 SEARCH_BLOCKING_DIRS = ("search",)
+
+# R8 scope: the hot paths whose timing must stay on the telemetry/
+# profiling seam (core/telemetry.py wall/mono/span; utils/profiling.py).
+# core/ and utils/ are the seam itself; launch/ is supervision, its
+# wall-clock heartbeats are protocol stamps, not measurements.
+TIMING_SEAM_DIRS = ("train", "search", "serve")
+
+#: the raw clock attributes R8 flags (time.monotonic is deadline
+#: plumbing, time.sleep is not a measurement — both stay legal)
+_R8_CLOCKS = {"time", "perf_counter"}
 
 # constructor names whose instances carry blocking .join()/.get()
 _THREAD_CTORS = {"Thread", "Timer"}
@@ -294,11 +315,12 @@ def check_source(src: str, relpath: str,
                  blocking_scope: bool | None = None,
                  jit_scope: bool | None = None,
                  serve_scope: bool | None = None,
-                 search_scope: bool | None = None) -> list[Finding]:
+                 search_scope: bool | None = None,
+                 timing_scope: bool | None = None) -> list[Finding]:
     """Lint one file's source.  `artifact_scope` forces R3 on/off,
     `blocking_scope` forces R4 on/off, `jit_scope` forces R5 on/off,
-    `serve_scope` forces R6 on/off, `search_scope` forces R7 on/off
-    (None = derive from `relpath`)."""
+    `serve_scope` forces R6 on/off, `search_scope` forces R7 on/off,
+    `timing_scope` forces R8 on/off (None = derive from `relpath`)."""
     findings: list[Finding] = []
     lines = src.splitlines()
 
@@ -326,6 +348,8 @@ def check_source(src: str, relpath: str,
         serve_scope = _in_dirs(SERVE_BLOCKING_DIRS)
     if search_scope is None:
         search_scope = _in_dirs(SEARCH_BLOCKING_DIRS)
+    if timing_scope is None:
+        timing_scope = _in_dirs(TIMING_SEAM_DIRS)
     blockers = _blocking_receivers(tree) if blocking_scope else set()
     # R6 (serve/) and R7 (search/) share one rule engine; a file lives
     # in at most one of the two scopes
@@ -425,6 +449,29 @@ def check_source(src: str, relpath: str,
                         f"{bounded_where} — {bounded_contract}: no "
                         "worker thread may park forever; pass a timeout "
                         "(or non-blocking form) and fail fast on expiry"))
+        if timing_scope and isinstance(node, ast.Attribute) \
+                and node.attr in _R8_CLOCKS \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "time" \
+                and not allowed(node.lineno):
+            findings.append(Finding(
+                relpath, node.lineno, "R8",
+                f"raw time.{node.attr} in a train/search/serve hot path "
+                "— route timing through the telemetry seam "
+                "(core/telemetry.py wall()/mono()/span()) or "
+                "utils/profiling.py so the measurement reaches the "
+                "registry/journal the artifacts stamp from"))
+        if timing_scope and isinstance(node, ast.ImportFrom) \
+                and node.module == "time" \
+                and not allowed(node.lineno):
+            for alias in node.names:
+                if alias.name in _R8_CLOCKS:
+                    findings.append(Finding(
+                        relpath, node.lineno, "R8",
+                        f"`from time import {alias.name}` in a "
+                        "train/search/serve hot path — the import-alias "
+                        "form of a raw clock read; use the telemetry "
+                        "seam (core/telemetry.py)"))
         if jit_scope and isinstance(node, ast.Attribute) \
                 and node.attr == "jit" \
                 and isinstance(node.value, ast.Name) \
