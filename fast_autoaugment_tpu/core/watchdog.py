@@ -272,9 +272,11 @@ class DispatchWatchdog:
                 with dispatch_enqueue_guard():
                     out = fn(*args)
                 out = jax.block_until_ready(out)
-                out_q.put(("ok", out, time.monotonic() - t0))
+                # put_nowait: maxsize-1 queue, single producer, one
+                # put per worker — can never block (lint R9)
+                out_q.put_nowait(("ok", out, time.monotonic() - t0))
             except BaseException as e:  # delivered to the caller below
-                out_q.put(("err", e, time.monotonic() - t0))
+                out_q.put_nowait(("err", e, time.monotonic() - t0))
 
         worker = threading.Thread(target=_worker, daemon=True,
                                   name=f"watchdog-{label}")
@@ -333,7 +335,7 @@ def _sleep(delay: float) -> None:
         return
     remaining = float(delay)
     while remaining > 0:
-        time.sleep(min(remaining, 60.0))
+        time.sleep(min(remaining, 60.0))  # robust: allow — deadline-bounded chunked sleep; inf = the deliberate injected wedge
         remaining -= 60.0
 
 

@@ -512,10 +512,22 @@ def prefetch(iterator, depth: int | None = None, transform=None):
                         err.append(e)
             put(_END)
 
-    threading.Thread(target=worker, daemon=True).start()
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=5.0)
+            except queue.Empty:
+                # bounded wait (lint R9): if the worker died without
+                # delivering its _END sentinel (e.g. killed hard), an
+                # untimed get would park the consumer forever; drained
+                # items always win over the liveness verdict
+                if t.is_alive():
+                    continue
+                if err:
+                    raise err[0]
+                return
             if item is _END:
                 if err:
                     raise err[0]

@@ -169,7 +169,7 @@ class AotPolicyApplier:
             else:
                 spec_key = jax.ShapeDtypeStruct((2,), jnp.uint32)
             label = f"serve_{dispatch}_b{s}"
-            self._exec[s], rec = aot_compile(
+            self._exec[s], rec = aot_compile(  # robust: allow — startup-only: one AOT executable per padded batch shape, never in the dispatch path
                 kernel, label=label, example_args=(spec_img, spec_key))
             self.compile_log[s] = rec
             if watchdog is not None:

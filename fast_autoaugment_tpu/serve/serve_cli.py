@@ -412,7 +412,7 @@ def make_handler(server, applier, state: ServeState | None = None,
                     self._send_error_json(500, "internal",
                                           f"{type(e).__name__}: {e}")
                 except OSError:
-                    pass  # robust: allow — client already gone
+                    pass  # client already gone (narrow except: no lint rule fires)
 
     return Handler
 

@@ -1,0 +1,17 @@
+"""faalint — the repo's multi-pass static analyzer for concurrency,
+dispatch-hazard, and determinism bugs (docs/STATIC_ANALYSIS.md).
+
+Public API::
+
+    from faalint import check_source, lint_tree, Finding
+    findings = check_source(src, "fast_autoaugment_tpu/serve/x.py")
+    findings = lint_tree()          # full repo, baseline + stale checks
+
+CLI::
+
+    python -m tools.faalint [--json] [--fail-on SEV] [--selfcheck]
+"""
+
+from .engine import (Finding, LEGACY_RULE_IDS, PACKAGE, REPO,  # noqa: F401
+                     check_source, default_baseline_path, default_rules,
+                     failing, lint_tree, load_baseline, scopes_for)

@@ -1,0 +1,112 @@
+"""faalint CLI: the `make lint` gate.
+
+Exit 0 = clean at the --fail-on threshold (baselined findings and the
+below-threshold tail are reported, not fatal); exit 1 = findings; exit
+2 = configuration error (unparseable baseline).  Prints the measured
+lint wall time — the single-parse engine must stay well under the ~10s
+budget on this 1-core host so the tier-1 preamble never eats test wall.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .engine import (REPO, default_baseline_path, default_rules, failing,
+                     lint_tree, load_baseline)
+
+
+def run_selfcheck(verbose: bool = True) -> list[str]:
+    """Run the regression corpus (pre-fix snippets of the historical
+    bugs): every prefix snippet must be flagged by exactly the intended
+    pass, every postfix snippet must be clean.  Returns problems."""
+    from .corpus import check_corpus
+
+    problems = check_corpus()
+    if verbose:
+        for p in problems:
+            print(f"faalint selfcheck: {p}", file=sys.stderr)
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="faalint",
+        description="multi-pass static analyzer (concurrency, dispatch "
+                    "hazards, determinism, robustness)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings + counts")
+    parser.add_argument("--baseline", default=None,
+                        help="reviewed baseline JSON (default: "
+                             "tools/faalint/baseline.json)")
+    parser.add_argument("--fail-on", default="warning",
+                        choices=("error", "warning", "info", "never"),
+                        help="minimum severity that fails the run "
+                             "(default: warning)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="verify the pre-fix regression corpus is "
+                             "caught (and the post-fix shapes are not)")
+    args = parser.parse_args(argv)
+
+    if args.selfcheck:
+        problems = run_selfcheck()
+        if problems:
+            print(f"faalint selfcheck: {len(problems)} problem(s)",
+                  file=sys.stderr)
+            return 1
+        print("faalint selfcheck: corpus ok")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    t0 = time.monotonic()
+    baseline_path = args.baseline or default_baseline_path()
+    try:
+        load_baseline(baseline_path)  # fail fast on an unjustified entry
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"faalint: bad baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    findings = lint_tree(args.root, baseline_path=baseline_path,
+                         rule_ids=rule_ids)
+    wall = time.monotonic() - t0
+    fatal = failing(findings, args.fail_on)
+    n_rules = len(default_rules()) if rule_ids is None else len(rule_ids)
+
+    if args.json:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "counts": counts,
+            "fatal": len(fatal),
+            "rules": n_rules,
+            "wall_sec": round(wall, 3),
+        }, indent=2, sort_keys=True))
+        return 1 if fatal else 0
+
+    for f in findings:
+        tag = " [baselined]" if f.baselined else ""
+        print(f"{f}{tag}")
+    if fatal:
+        print(f"faalint: {len(fatal)} finding(s) "
+              f"({len(findings) - len(fatal)} baselined/below threshold) "
+              f"in {wall:.2f}s", file=sys.stderr)
+        return 1
+    extra = f", {len(findings)} baselined/non-fatal" if findings else ""
+    print(f"faalint: clean — {n_rules} rules, single parse per file"
+          f"{extra}, {wall:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Post-fix shape of admission control: NON-BLOCKING put, typed
+overload error for the 429 path — the shipped PR-8 idiom.  Must
+produce ZERO findings."""
+
+import queue
+
+
+class ServerOverloadedError(RuntimeError):
+    def __init__(self, retry_after_s=0.05):
+        super().__init__("server overloaded")
+        self.retry_after_s = retry_after_s
+
+
+class PolicyServer:
+    def __init__(self, depth):
+        self._q = queue.Queue(maxsize=depth)
+
+    def submit(self, request):
+        try:
+            self._q.put(request, block=False)  # fail-fast admission
+        except queue.Full:
+            raise ServerOverloadedError() from None
+        return request
+
+    def _take(self):
+        return self._q.get(timeout=0.25)

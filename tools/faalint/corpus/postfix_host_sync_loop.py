@@ -1,0 +1,23 @@
+"""Post-fix shape of the dispatch loop: device values accumulate
+per-dispatch and convert ONCE at the epoch boundary (the shipped PR-4
+``_sum_metric_dicts`` idiom).  Must produce ZERO findings."""
+
+from fast_autoaugment_tpu.core.compilecache import seam_jit
+
+
+def sum_metric_dicts(dicts):
+    total = {}
+    for d in dicts:
+        for k, v in d.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def train_epoch(body, state, batches):
+    step = seam_jit(body, label="train_step")
+    per_dispatch = []
+    for batch in batches:
+        state, metrics = step(state, batch)
+        per_dispatch.append(metrics)  # stays on device, no sync
+    totals = sum_metric_dicts(per_dispatch)
+    return state, float(totals["loss"])  # one conversion per epoch

@@ -1,0 +1,13 @@
+"""Post-fix shape: the seam call is hoisted above the loop — one
+jitted callable, one compile, N dispatches.  Must produce ZERO
+findings."""
+
+from fast_autoaugment_tpu.core.compilecache import seam_jit
+
+
+def evaluate(body, state, batches):
+    step = seam_jit(body, label="eval_step")
+    outs = []
+    for batch in batches:
+        outs.append(step(state, batch))
+    return outs

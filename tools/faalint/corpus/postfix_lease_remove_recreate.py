@@ -1,0 +1,29 @@
+"""Post-fix shape of the lease reclaim: in-place replace (the lease
+path never disappears) and atomic ``os.link`` test-and-set for fresh
+claims — the shipped PR-6 idiom.  Must produce ZERO findings."""
+
+import os
+
+from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+
+def reclaim_stale_lease(lease_path, owner, stale):
+    # in-place replace: write_json_atomic renames over the live lease,
+    # so there is no absence window for a racing fresh claim
+    write_json_atomic(lease_path, {
+        "owner": owner,
+        "attempt": int(stale.get("attempt", 1)) + 1,
+        "reclaimed_from": stale.get("owner"),
+    })
+    return True
+
+
+def claim_fresh(lease_path, tmp_path, owner):
+    write_json_atomic(tmp_path, {"owner": owner, "attempt": 1})
+    try:
+        os.link(tmp_path, lease_path)  # atomic test-and-set: one winner
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        os.remove(tmp_path)

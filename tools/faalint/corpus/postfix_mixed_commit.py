@@ -1,0 +1,18 @@
+"""Post-fix shape of the mixed-commitment dispatch: the carried state
+is committed to the mesh BEFORE the loop (the shipped PR-4 fix in
+train/trainer.py).  Must produce ZERO findings."""
+
+import jax
+
+from fast_autoaugment_tpu.core.compilecache import seam_jit
+
+
+def train_epochs(body, dataset, state, sharding, replicated, index, steps):
+    step = seam_jit(body, label="train_step")
+    cache = jax.device_put(dataset, sharding)
+    # commit the carried state before the first dispatch: committed +
+    # committed stays on the C++ fast path
+    state = jax.device_put(state, replicated)
+    for _ in range(steps):
+        state, metrics = step(state, cache, index)
+    return state

@@ -1,0 +1,16 @@
+"""Post-fix shape: every unordered source is ``sorted()`` before it
+reaches the artifact.  Must produce ZERO findings."""
+
+import os
+
+from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+
+def collect_done_units(done_dir, out_path):
+    units = []
+    for name in sorted(os.listdir(done_dir)):
+        if name.endswith(".json"):
+            units.append(name)
+    seen = set(units)
+    merged = [u for u in sorted(seen)]
+    write_json_atomic(out_path, {"units": merged})

@@ -1,0 +1,16 @@
+"""Post-fix shape: artifacts stamp run INPUTS (seed, config, epoch,
+FAA_HOST_ID/FAA_ATTEMPT identity) — all reproducible on resume; timing
+evidence lives in the telemetry journal, not the artifact.  Must
+produce ZERO findings."""
+
+from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+
+def persist_result(path, results, seed, epoch, host_id):
+    payload = {
+        "results": results,
+        "seed": int(seed),
+        "epoch": int(epoch),
+        "host": str(host_id),  # FAA_HOST_ID: stable across resume
+    }
+    write_json_atomic(path, payload)

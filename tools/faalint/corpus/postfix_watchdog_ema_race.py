@@ -1,0 +1,36 @@
+"""Post-fix shape of the watchdog EMA race: every access to the shared
+label state holds the one RLock (the shipped PR-9 fix).  Must produce
+ZERO findings."""
+
+import threading
+import time
+
+
+class DispatchWatchdog:
+    def __init__(self, alpha=0.3):
+        self.alpha = alpha
+        self.fires = 0
+        self._ema = {}
+        self._calls = {}
+        self._lock = threading.RLock()
+
+    def observe(self, label, wall_sec):
+        with self._lock:
+            self._calls[label] = self._calls.get(label, 0) + 1
+            prev = self._ema.get(label)
+            if prev is None:
+                self._ema[label] = float(wall_sec)
+            else:
+                self._ema[label] = (self.alpha * float(wall_sec)
+                                    + (1.0 - self.alpha) * prev)
+
+    def run(self, label, fn):
+        def _monitor():
+            t0 = time.monotonic()
+            fn()
+            with self._lock:
+                self._ema[label] = time.monotonic() - t0
+
+        t = threading.Thread(target=_monitor, daemon=True)
+        t.start()
+        t.join(timeout=60.0)

@@ -1,0 +1,27 @@
+"""Pre-fix regression snippet: the PR-8 blocking-admission bug.
+
+``PolicyServer.submit`` put requests on the bounded queue with a
+BLOCKING put — a full queue parked every HTTP handler thread for the
+full timeout instead of failing fast, and the serving plane collapsed
+under overload (~70x goodput loss at 4x offered load).  Fixed by
+non-blocking admission + typed ``ServerOverloadedError`` → 429 with
+Retry-After (PR 8).
+
+Intended pass: robustness/blocking (R6).
+"""
+
+import queue
+
+
+class PolicyServer:
+    def __init__(self, depth):
+        self._q = queue.Queue(maxsize=depth)
+
+    def submit(self, request):
+        # PRE-FIX: blocking admission — a full queue parks the handler
+        # thread instead of shedding with a typed overload error
+        self._q.put(request)
+        return request
+
+    def _take(self):
+        return self._q.get(timeout=0.25)

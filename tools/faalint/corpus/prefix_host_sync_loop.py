@@ -1,0 +1,21 @@
+"""Pre-fix regression snippet: per-dispatch host-device sync.
+
+Converting a jitted step's metrics to Python floats INSIDE the
+dispatch loop blocks the queue on a device round-trip every step —
+the host-loop pitfall PR 4 measured and fixed with epoch-end host
+summation.
+
+Intended pass: dispatch (D1).
+"""
+
+from fast_autoaugment_tpu.core.compilecache import seam_jit
+
+
+def train_epoch(body, state, batches):
+    step = seam_jit(body, label="train_step")
+    losses = []
+    for batch in batches:
+        state, metrics = step(state, batch)
+        # PRE-FIX: a host-device sync per dispatch
+        losses.append(float(metrics["loss"]))
+    return state, losses

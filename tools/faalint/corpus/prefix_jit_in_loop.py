@@ -1,0 +1,19 @@
+"""Pre-fix regression snippet: compile seam inside a loop body.
+
+Re-wrapping the body through the compile seam every iteration builds a
+fresh jitted callable per lap — each first call pays the 23-55s
+compile tax the persistent cache exists to kill.
+
+Intended pass: dispatch (D2).
+"""
+
+from fast_autoaugment_tpu.core.compilecache import seam_jit
+
+
+def evaluate(body, state, batches):
+    outs = []
+    for batch in batches:
+        # PRE-FIX: a fresh jit (and a fresh compile) per iteration
+        step = seam_jit(body, label="eval_step")
+        outs.append(step(state, batch))
+    return outs

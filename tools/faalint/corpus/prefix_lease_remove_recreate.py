@@ -1,0 +1,27 @@
+"""Pre-fix regression snippet: the PR-6 lease reclaim provenance race.
+
+The stale-lease steal removed the lease file and then wrote a fresh
+one.  In the absence window between the two, a racing host saw "no
+lease", claimed fresh with attempt=1, and silently dropped the reclaim
+provenance (a test caught it).  Fixed by replacing the lease IN PLACE
+under a fence file taken via atomic ``os.link`` (PR 6).
+
+Intended pass: concurrency (C3).
+"""
+
+import os
+
+from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+
+def reclaim_stale_lease(lease_path, owner, stale):
+    # PRE-FIX: drop the stale lease, then recreate it — the absence
+    # window between remove and write lets a racing fresh claim land
+    # with attempt=1
+    os.remove(lease_path)
+    write_json_atomic(lease_path, {
+        "owner": owner,
+        "attempt": int(stale.get("attempt", 1)) + 1,
+        "reclaimed_from": stale.get("owner"),
+    })
+    return True

@@ -1,0 +1,20 @@
+"""Pre-fix regression snippet: unordered iteration feeding a persisted
+artifact — readdir order and set order leak the filesystem / hash seed
+into the payload.
+
+Intended pass: determinism (T2).
+"""
+
+import os
+
+from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+
+def collect_done_units(done_dir, out_path):
+    units = []
+    for name in os.listdir(done_dir):  # readdir order leaks in
+        if name.endswith(".json"):
+            units.append(name)
+    seen = set(units)
+    merged = [u for u in seen]  # set order leaks in
+    write_json_atomic(out_path, {"units": merged})

@@ -1,0 +1,23 @@
+"""Pre-fix regression snippet: wall-clock and process identity flowing
+into a persisted artifact.
+
+The repo's acceptance drills diff artifacts byte-for-byte across
+hosts, resumes and reclaims (PR 6/9) — a ``time.time()`` stamp or a
+pid in the payload breaks every one of them.
+
+Intended pass: determinism (T1 + T3).
+"""
+
+import os
+import time
+
+from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+
+def persist_result(path, results):
+    payload = {
+        "results": results,
+        "finished_at": time.time(),  # wall clock into the artifact
+        "writer_pid": os.getpid(),   # process identity into the artifact
+    }
+    write_json_atomic(path, payload)

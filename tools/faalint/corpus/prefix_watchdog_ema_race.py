@@ -1,0 +1,44 @@
+"""Pre-fix regression snippet: the PR-9 watchdog label-state data race.
+
+``DispatchWatchdog`` EMA/call-count dicts were written both from the
+monitor thread body and from the public ``observe()`` that every actor
+thread calls — no lock anywhere.  One monitored dispatch per actor
+concurrently lost observations and corrupted deadlines.  Fixed by
+RLock-guarding all label state (PR 9 satellite b).
+
+Intended pass: concurrency (C2).
+"""
+
+import threading
+import time
+
+
+class DispatchWatchdog:
+    def __init__(self, alpha=0.3):
+        self.alpha = alpha
+        self.fires = 0
+        self._ema = {}
+        self._calls = {}
+
+    def observe(self, label, wall_sec):
+        # PUBLIC and UNLOCKED: actor threads call this concurrently
+        # with the monitor thread's bookkeeping below
+        self._calls[label] = self._calls.get(label, 0) + 1
+        prev = self._ema.get(label)
+        if prev is None:
+            self._ema[label] = float(wall_sec)
+        else:
+            self._ema[label] = (self.alpha * float(wall_sec)
+                                + (1.0 - self.alpha) * prev)
+
+    def run(self, label, fn):
+        def _monitor():
+            t0 = time.monotonic()
+            fn()
+            # the thread body writes the same shared dict the public
+            # method writes — the data race
+            self._ema[label] = time.monotonic() - t0
+
+        t = threading.Thread(target=_monitor, daemon=True)
+        t.start()
+        t.join(timeout=60.0)

@@ -1,0 +1,298 @@
+"""Concurrency rules C1–C3: the lock/thread/file-race bug classes this
+repo has shipped and then fixed by hand (see docs/STATIC_ANALYSIS.md
+for the incident each rule pins).
+
+C1  **lock-order inversion**: a per-module lock acquisition graph is
+    built from lexically nested ``with <lock>:`` blocks (receivers
+    bound from Lock/RLock/Condition constructors, or named like
+    locks); any cycle between two or more distinct locks is a
+    deadlock-prone ordering and every edge on the cycle is flagged.
+
+C2  **thread-shared unguarded writes** (the PR-9 watchdog EMA race
+    shape): within a class that starts a ``Thread(target=...)``, an
+    attribute written both from the thread body (including the methods
+    it transitively calls and nested ``def`` targets) and from another
+    method, where a write on either side is not under a ``with
+    <lock>:``, is a data race.  ``__init__`` writes are
+    happens-before thread start and excluded.
+
+C3  **remove-then-recreate** (the PR-6 lease reclaim race): inside one
+    function, ``os.remove``/``os.unlink`` of a path followed by a
+    recreation of the SAME path expression (``write_json_atomic``,
+    ``save_checkpoint``, write-mode ``open``, ``os.rename``/
+    ``os.replace`` destination) leaves an absence window a racing
+    claimer can land in.  ``os.link`` recreation is exempt — that IS
+    the atomic test-and-set idiom the fix used.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, FileContext, Rule, _ctor_name, _recv_key
+
+_WRITE_TARGET_TYPES = (ast.Assign, ast.AugAssign, ast.AnnAssign)
+
+
+def _self_attr_of_store(target) -> str | None:
+    """``self.x = ...`` / ``self.x[k] = ...`` -> ``x`` (the shared
+    attribute the store mutates), else None."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Attribute) \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id == "self":
+        return target.attr
+    return None
+
+
+def _stores_in(fn: ast.AST):
+    """(attr, node) for every self-attribute store lexically inside
+    `fn` (the caller re-attributes stores that sit inside a nested
+    Thread-target def)."""
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            attr = _self_attr_of_store(tgt)
+            if attr is not None:
+                yield attr, node
+
+
+class LockOrderInversion(Rule):
+    id = "C1"
+    pass_name = "concurrency"
+    scope_key = "concurrency"
+
+    def _lock_key(self, ctx: FileContext, expr, node) -> str | None:
+        if not ctx.is_lockish(expr):
+            return None
+        key = _recv_key(expr)
+        if key is None:
+            return None
+        if key.startswith("self."):
+            cls = ctx.enclosing_class(node)
+            return f"{cls.name if cls else '?'}.{key}"
+        return key
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        # edges: outer-lock -> inner-lock, with the observation site
+        edges: dict[tuple[str, str], int] = {}
+        for w in ctx.of(ast.With, ast.AsyncWith):
+            inner_keys = [self._lock_key(ctx, item.context_expr, w)
+                          for item in w.items]
+            inner_keys = [k for k in inner_keys if k]
+            if not inner_keys:
+                continue
+            outer_keys: list[str] = []
+            # multi-item `with a, b:` — earlier items are outer
+            for i, k in enumerate(inner_keys[:-1]):
+                edges.setdefault((k, inner_keys[i + 1]), w.lineno)
+            for anc in ctx.ancestors(w):
+                if isinstance(anc, (ast.With, ast.AsyncWith)):
+                    outer_keys.extend(
+                        k for k in (self._lock_key(ctx, it.context_expr, anc)
+                                    for it in anc.items) if k)
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break  # ordering is a per-call-stack property
+            for outer in outer_keys:
+                for inner in inner_keys:
+                    if outer != inner:
+                        edges.setdefault((outer, inner), w.lineno)
+        if not edges:
+            return []
+        # transitive closure; an edge is part of a cycle iff its head
+        # reaches its tail
+        reach: dict[str, set[str]] = {}
+        for a, b in edges:
+            reach.setdefault(a, set()).add(b)
+        changed = True
+        while changed:
+            changed = False
+            for a in list(reach):
+                for b in list(reach[a]):
+                    extra = reach.get(b, set()) - reach[a]
+                    if extra:
+                        reach[a] |= extra
+                        changed = True
+        out = []
+        for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+            if a in reach.get(b, set()):
+                out.append(self.finding(
+                    ctx, line,
+                    f"lock-order inversion: '{b}' is acquired under "
+                    f"'{a}' here, but elsewhere '{a}' is acquired "
+                    f"under '{b}' — a deadlock-prone cycle; pick one "
+                    "global order"))
+        return out
+
+
+class ThreadSharedUnguardedWrite(Rule):
+    id = "C2"
+    pass_name = "concurrency"
+    scope_key = "concurrency"
+
+    def _thread_targets(self, ctx: FileContext, cls: ast.ClassDef,
+                        methods: dict) -> list[ast.AST]:
+        """The function bodies a ``Thread(target=...)`` created inside
+        `cls` will run: bound methods (``target=self._run``) and
+        nested ``def`` targets (``target=_worker``)."""
+        bodies: list[ast.AST] = []
+        for call in ast.walk(cls):
+            if not (isinstance(call, ast.Call)
+                    and _ctor_name(call) in ("Thread", "Timer")):
+                continue
+            for kw in call.keywords:
+                if kw.arg != "target":
+                    continue
+                t = kw.value
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and t.attr in methods:
+                    bodies.append(methods[t.attr])
+                elif isinstance(t, ast.Name):
+                    fn = ctx.enclosing_function(call)
+                    if fn is not None:
+                        for node in ast.walk(fn):
+                            if isinstance(node, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef)) \
+                                    and node.name == t.id:
+                                bodies.append(node)
+        return bodies
+
+    def _closure(self, bodies: list, methods: dict) -> set[str]:
+        """Method names transitively reachable from the thread bodies
+        via ``self.m(...)`` calls — they run on the worker thread."""
+        seen: set[str] = {b.name for b in bodies if hasattr(b, "name")}
+        frontier = list(bodies)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self" \
+                        and node.func.attr in methods \
+                        and node.func.attr not in seen:
+                    seen.add(node.func.attr)
+                    frontier.append(methods[node.func.attr])
+        return seen
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for cls in ctx.of(ast.ClassDef):
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            bodies = self._thread_targets(ctx, cls, methods)
+            if not bodies:
+                continue
+            thread_methods = self._closure(bodies, methods)
+            nested_bodies = [b for b in bodies
+                             if getattr(b, "name", None) not in methods]
+
+            def in_nested_body(node) -> bool:
+                return any(anc in nested_bodies
+                           for anc in ctx.ancestors(node))
+
+            thread_writes: dict[str, list] = {}
+            other_writes: dict[str, list] = {}
+            for name, fn in methods.items():
+                if name == "__init__":
+                    continue  # happens-before thread start
+                bucket = thread_writes if name in thread_methods \
+                    else other_writes
+                for attr, node in _stores_in(fn):
+                    # stores inside a nested Thread-target def belong
+                    # to the thread body, not the enclosing method
+                    if in_nested_body(node):
+                        thread_writes.setdefault(attr, []).append(node)
+                    else:
+                        bucket.setdefault(attr, []).append(node)
+            shared = set(thread_writes) & set(other_writes)
+            seen_lines: set[int] = set()
+            for attr in sorted(shared):
+                for node in thread_writes[attr] + other_writes[attr]:
+                    if ctx.lock_guarded(node) or node.lineno in seen_lines:
+                        continue
+                    seen_lines.add(node.lineno)
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"attribute 'self.{attr}' is written both from "
+                        f"a Thread(target=...) body and from another "
+                        f"method of {cls.name}, and this write holds no "
+                        "lock — the watchdog-EMA race class (PR 9): "
+                        "guard every access with one lock"))
+        return out
+
+
+_REMOVERS = {"remove", "unlink"}
+
+
+def _path_key(expr) -> str:
+    return ast.dump(expr)
+
+
+class RemoveThenRecreate(Rule):
+    id = "C3"
+    pass_name = "concurrency"
+    scope_key = "artifact"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        from .rules_robustness import _write_mode
+
+        # bucket removals and recreations by enclosing function
+        removals: dict[int, list[tuple[str, ast.Call]]] = {}
+        recreates: dict[int, list[tuple[str, int]]] = {}
+        for call in ctx.of(ast.Call):
+            fn = ctx.enclosing_function(call)
+            fid = id(fn) if fn is not None else 0
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr in _REMOVERS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "os" and call.args:
+                removals.setdefault(fid, []).append(
+                    (_path_key(call.args[0]), call))
+                continue
+            target = None
+            if isinstance(f, ast.Name):
+                if f.id in ("write_json_atomic", "_write_json_atomic",
+                            "save_checkpoint") and call.args:
+                    target = call.args[0]
+                elif f.id == "open" and call.args and _write_mode(call):
+                    target = call.args[0]
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name):
+                if f.value.id == "os" and f.attr in ("rename", "replace") \
+                        and len(call.args) >= 2:
+                    target = call.args[1]
+                # os.link is the atomic test-and-set claim idiom: a
+                # remove-then-link race has exactly one winner, so it
+                # is NOT the absence-window bug — exempt by design
+            if target is not None:
+                recreates.setdefault(fid, []).append(
+                    (_path_key(target), call.lineno))
+        out: list[Finding] = []
+        for fid, removes in removals.items():
+            creates = recreates.get(fid, [])
+            for key, call in removes:
+                later = [ln for k, ln in creates
+                         if k == key and ln > call.lineno]
+                if later:
+                    out.append(self.finding(
+                        ctx, call.lineno,
+                        "remove-then-recreate on the same path (recreated "
+                        f"at line {min(later)}) — the absence window lets "
+                        "a racing claimer land and drop provenance (the "
+                        "PR-6 lease race): replace in place "
+                        "(write_json_atomic / os.replace) or claim via "
+                        "atomic os.link"))
+        return out
+
+
+def RULES() -> list[Rule]:
+    return [LockOrderInversion(), ThreadSharedUnguardedWrite(),
+            RemoveThenRecreate()]

@@ -1,0 +1,247 @@
+"""Determinism rules T1–T3: the byte-identical-artifact contract,
+enforced statically in the artifact-writing layers (core/, search/,
+train/ — everything funneled through ``write_json_atomic`` /
+``save_checkpoint``).  The repo's acceptance drills diff artifacts
+byte-for-byte across hosts, resumes and reclaims; one wall-clock or
+pid leaking into a payload breaks every one of them.
+
+The rules are function-local and taint-based: a function counts as
+artifact-writing when it calls one of the atomic writers; inside it,
+values derived from nondeterministic sources that reach a writer call's
+arguments are flagged.
+
+T1  **wall-clock into a persisted payload**: ``time.time()`` /
+    ``datetime.now()`` / the telemetry ``wall()`` seam flowing into a
+    writer argument.
+T2  **unordered iteration in an artifact-writing function**:
+    iterating a ``set`` or an unsorted ``os.listdir`` — the iteration
+    order (hash seed / readdir order) leaks into whatever is built
+    from it; wrap in ``sorted()``.
+T3  **process-identity into a persisted payload**: ``os.getpid()`` /
+    ``id()`` / ``threading.get_ident()`` values are distinct per
+    process by construction — a resume or a reclaiming host can never
+    reproduce them.
+
+launch/ is deliberately out of scope: lease and heartbeat records are
+wall-clock + pid stamped BY DESIGN (staleness detection is their
+function) — see docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, FileContext, Rule
+
+_WRITERS = {"write_json_atomic", "_write_json_atomic", "save_checkpoint"}
+
+#: wall-clock sources (T1): (base, attr) attribute calls or bare names
+_WALL_ATTRS = {("time", "time"), ("time", "time_ns"),
+               ("datetime", "now"), ("datetime", "utcnow"),
+               ("datetime", "today"), ("date", "today"),
+               ("telemetry", "wall")}
+_WALL_NAMES = {"wall"}
+
+#: process-identity sources (T3)
+_PID_ATTRS = {("os", "getpid"), ("os", "getppid"),
+              ("threading", "get_ident")}
+_PID_NAMES = {"id"}
+
+
+def _source_kind(call: ast.Call) -> tuple[str, str] | None:
+    """('T1'|'T3', printable source name) when `call` reads a
+    nondeterministic source."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        pair = (f.value.id, f.attr)
+        if pair in _WALL_ATTRS:
+            return "T1", f"{pair[0]}.{pair[1]}()"
+        if pair in _PID_ATTRS:
+            return "T3", f"{pair[0]}.{pair[1]}()"
+    elif isinstance(f, ast.Name):
+        if f.id in _WALL_NAMES:
+            return "T1", f"{f.id}()"
+        if f.id in _PID_NAMES and len(call.args) == 1:
+            return "T3", f"{f.id}()"
+    return None
+
+
+def _writer_call(call: ast.Call) -> bool:
+    f = call.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    return name in _WRITERS
+
+
+def _unordered_value(value) -> str | None:
+    """Why iterating `value` is unordered: a set display/constructor or
+    an unsorted os.listdir.  A top-level ``sorted(...)`` wrapper makes
+    any of them ordered."""
+    if isinstance(value, ast.Set):
+        return "a set display"
+    if isinstance(value, ast.Call):
+        f = value.func
+        if isinstance(f, ast.Name):
+            if f.id == "set":
+                return "set(...)"
+            if f.id == "sorted":
+                return None
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "os" and f.attr == "listdir":
+            return "os.listdir(...)"
+    return None
+
+
+class _DetFunctions:
+    """The analysis units: functions containing a writer call, with a
+    per-function taint table (name -> (kind, source)) built in one
+    forward pass over the assignments."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.units: dict[int, dict] = {}
+        for call in ctx.of(ast.Call):
+            if _writer_call(call):
+                fn = ctx.enclosing_function(call)
+                unit = self.units.setdefault(
+                    id(fn), {"fn": fn, "writers": [], "taint": {},
+                             "unordered": {}})
+                unit["writers"].append(call)
+        if not self.units:
+            return
+        for fid, unit in self.units.items():
+            fn = unit["fn"]
+            if fn is None:  # module-level writer calls
+                nodes = [n for n in ctx.nodes
+                         if ctx.enclosing_function(n) is None]
+            else:
+                nodes = list(ast.walk(fn))
+            taint: dict[str, set[tuple[str, str]]] = {}
+            unordered: dict[str, str] = {}
+            assigns = sorted(
+                (n for n in nodes if isinstance(n, ast.Assign)),
+                key=lambda n: n.lineno)
+            for node in assigns:
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if not names:
+                    continue
+                verdicts = self._expr_taint(node.value, taint)
+                if verdicts:
+                    for nm in names:
+                        taint.setdefault(nm, set()).update(verdicts)
+                why = _unordered_value(node.value)
+                if why:
+                    for nm in names:
+                        unordered[nm] = why
+            unit["taint"] = taint
+            unit["unordered"] = unordered
+            unit["nodes"] = nodes
+
+    def _expr_taint(self, expr, taint) -> set[tuple[str, str]]:
+        out: set[tuple[str, str]] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                kind = _source_kind(node)
+                if kind:
+                    out.add(kind)
+            if isinstance(node, ast.Name) and node.id in taint \
+                    and isinstance(node.ctx, ast.Load):
+                out |= taint[node.id]
+        return out
+
+
+def _det_functions(ctx: FileContext) -> _DetFunctions:
+    if "det_units" not in ctx._caches:
+        ctx._caches["det_units"] = _DetFunctions(ctx)
+    return ctx._caches["det_units"]
+
+
+class _PayloadTaintRule(Rule):
+    kind = "?"
+    what = "?"
+    fix = "?"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for unit in _det_functions(ctx).units.values():
+            taint = unit["taint"]
+            for writer in unit["writers"]:
+                sources: set[str] = set()
+                for arg in list(writer.args) + [kw.value for kw
+                                                in writer.keywords]:
+                    for node in ast.walk(arg):
+                        if isinstance(node, ast.Call):
+                            k = _source_kind(node)
+                            if k and k[0] == self.kind:
+                                sources.add(k[1])
+                        elif isinstance(node, ast.Name) \
+                                and isinstance(node.ctx, ast.Load) \
+                                and node.id in taint:
+                            for kind, src in taint[node.id]:
+                                if kind == self.kind:
+                                    sources.add(f"'{node.id}' (from {src})")
+                if sources:
+                    out.append(self.finding(
+                        ctx, writer.lineno,
+                        f"{self.what} flows into this persisted "
+                        f"artifact via {', '.join(sorted(sources))} — "
+                        "the byte-identical-artifact contract "
+                        f"(docs/STATIC_ANALYSIS.md): {self.fix}"))
+        return out
+
+
+class WallClockIntoArtifact(_PayloadTaintRule):
+    id = "T1"
+    pass_name = "determinism"
+    scope_key = "determinism"
+    kind = "T1"
+    what = "a wall-clock value"
+    fix = ("derive stamps from run inputs (seed/config/epoch), or move "
+           "timing evidence to the telemetry journal")
+
+
+class PidIntoArtifact(_PayloadTaintRule):
+    id = "T3"
+    pass_name = "determinism"
+    scope_key = "determinism"
+    kind = "T3"
+    what = "a process-identity value"
+    fix = ("identify runs by FAA_HOST_ID/FAA_ATTEMPT (stable across "
+           "resume), never by pid/id()")
+
+
+class UnorderedIteration(Rule):
+    id = "T2"
+    pass_name = "determinism"
+    scope_key = "determinism"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for unit in _det_functions(ctx).units.values():
+            unordered = unit["unordered"]
+            nodes = unit.get("nodes", [])
+            iters = []
+            for node in nodes:
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append((node.iter, node.lineno))
+                elif isinstance(node, ast.comprehension):
+                    iters.append((node.iter, getattr(
+                        node.iter, "lineno", 0)))
+            for it, lineno in iters:
+                why = _unordered_value(it)
+                if why is None and isinstance(it, ast.Name) \
+                        and it.id in unordered:
+                    why = f"'{it.id}' ({unordered[it.id]})"
+                if why:
+                    out.append(self.finding(
+                        ctx, lineno,
+                        f"iteration over {why} in an artifact-writing "
+                        "function — set/readdir order leaks the hash "
+                        "seed / filesystem into the artifact; wrap in "
+                        "sorted(...)"))
+        return out
+
+
+def RULES() -> list[Rule]:
+    return [WallClockIntoArtifact(), UnorderedIteration(), PidIntoArtifact()]

@@ -1,0 +1,343 @@
+"""Robustness rules R1–R9 (R1–R8 migrated verbatim from the legacy
+``tools/lint_robustness.py``; R9 extends the unbounded-blocking engine
+to the remaining thread code).
+
+R1  no bare ``except:`` — swallows KeyboardInterrupt/SystemExit and
+    the typed resilience signals.
+R2  no swallowed broad excepts — ``except Exception`` must log,
+    re-raise, or capture the bound value.
+R3  no direct run-artifact writes in core/search/train/launch —
+    ``json.dump`` / write-mode ``open`` are reserved to
+    ``write_json_atomic`` / ``save_checkpoint``.
+R4  no untimed ``Thread.join()`` / ``Queue.get()`` in the
+    supervision layers (core/launch/search).
+R5  no ``jax.jit`` outside the compile seam in train/search/serve.
+R6  no unbounded blocking in serve/ (the blocking-admission bug
+    class, PR 8).
+R7  the R6 engine over search/ (the async pipeline contract, PR 9).
+R8  no raw ``time.time``/``time.perf_counter`` in train/search/serve
+    hot paths — timing routes through the telemetry seam (PR 10).
+R9  the R6/R7 unbounded-blocking engine extended to core/, launch/,
+    data/ and utils/ thread code: untimed ``put``/``get``/``wait``/
+    ``join`` on constructor-tracked receivers and bare
+    ``time.sleep`` poll loops.  join/get already policed by R4 in
+    core/launch are not double-flagged (one finding per hazard).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import (BLOCKING_DIRS, Finding, FileContext, Rule, _in_dirs,
+                     _recv_key)
+
+_LOG_NAMES = {"logger", "logging", "log", "warnings"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "fatal"}
+
+#: R6-family blocking methods and the positional index their timeout
+#: lands at (``put(item)`` has ONE arg and still blocks forever;
+#: ``get()``/``join()``/``wait()`` block with ZERO args)
+_BOUNDED_METHODS = {"put": 1, "get": 0, "join": 0, "wait": 0}
+
+_R8_CLOCKS = {"time", "perf_counter"}
+
+# (relative module path suffix, function name) pairs allowed to write
+# directly: THE atomic helpers themselves.
+ARTIFACT_WRITERS = {
+    ("core/checkpoint.py", "save_checkpoint"),
+    ("search/driver.py", "write_json_atomic"),
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handles_failure(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body logs, re-raises, or captures the
+    bound exception value (the propagate-through-a-channel pattern)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name \
+                and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                base = f.value
+                if isinstance(base, ast.Name) and (
+                        base.id in _LOG_NAMES
+                        or base.id.startswith("log")) \
+                        and f.attr in _LOG_METHODS | {"warn"}:
+                    return True
+                if isinstance(base, ast.Name) and base.id == "warnings" \
+                        and f.attr == "warn":
+                    return True
+    return False
+
+
+def _write_mode(call: ast.Call) -> str | None:
+    """The mode string of an ``open`` call if it writes, else None."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            mode = kw.value.value
+    if mode and ("w" in mode or "x" in mode or "+" in mode):
+        return mode
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """R4: ANY argument bounds the call (positional timeout,
+    ``get(False)``, or ``timeout=``)."""
+    return bool(call.args) or any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _bounded(call: ast.Call, method: str) -> bool:
+    """R6-family: positional args past the payload slot or a
+    ``block=``/``timeout=`` keyword."""
+    if len(call.args) > _BOUNDED_METHODS[method]:
+        return True
+    return any(kw.arg in ("timeout", "block") for kw in call.keywords)
+
+
+def _sleep_calls_in_while(ctx: FileContext):
+    """``time.sleep`` calls lexically inside a ``while`` body."""
+    for call in ctx.of(ast.Call):
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "sleep" \
+                and isinstance(f.value, ast.Name) and f.value.id == "time" \
+                and ctx.enclosing(call, ast.While) is not None:
+            yield call
+
+
+class BareExcept(Rule):
+    id = "R1"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        return [self.finding(
+            ctx, h.lineno,
+            "bare `except:` swallows SystemExit/KeyboardInterrupt and "
+            "the typed resilience signals — name the exceptions")
+            for h in ctx.of(ast.ExceptHandler) if h.type is None]
+
+
+class SwallowedBroadExcept(Rule):
+    id = "R2"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        return [self.finding(
+            ctx, h.lineno,
+            "broad `except Exception` neither logs nor re-raises — a "
+            "swallowed failure leaves no evidence")
+            for h in ctx.of(ast.ExceptHandler)
+            if h.type is not None and _is_broad(h)
+            and not _handles_failure(h)]
+
+
+class DirectArtifactWrite(Rule):
+    id = "R3"
+    scope_key = "artifact"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        norm = ctx.relpath.replace("\\", "/")
+        func_of = ctx.outer_func_of_line()
+
+        def allowlisted(lineno: int) -> bool:
+            fn = func_of.get(lineno, "")
+            return any(norm.endswith(suffix) and fn == name
+                       for suffix, name in ARTIFACT_WRITERS)
+
+        out: list[Finding] = []
+        for node in ctx.of(ast.Call):
+            if allowlisted(node.lineno):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "dump" \
+                    and isinstance(f.value, ast.Name) and f.value.id == "json":
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "direct json.dump to a run artifact — use "
+                    "write_json_atomic (fsync + rename) so a crash "
+                    "cannot tear the file"))
+            elif isinstance(f, ast.Name) and f.id == "open":
+                mode = _write_mode(node)
+                if mode:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"direct open(..., {mode!r}) write to a run "
+                        "artifact — route through write_json_atomic / "
+                        "save_checkpoint"))
+        return out
+
+
+class UntimedSupervisionBlock(Rule):
+    id = "R4"
+    scope_key = "blocking"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        blockers = ctx.blocking_receivers()
+        if not blockers:
+            return []
+        out: list[Finding] = []
+        for node in ctx.of(ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("join", "get") \
+                    and _recv_key(f.value) in blockers \
+                    and not _has_timeout(node):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"untimed blocking .{f.attr}() on a Thread/Queue — "
+                    "pass a timeout (the watchdog contract: supervision "
+                    "code must never be able to hang forever)"))
+        return out
+
+
+class DirectJit(Rule):
+    id = "R5"
+    scope_key = "jit"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        # catches direct calls, functools.partial(jax.jit, ...) AND
+        # @jax.jit decorators: any reference to the attribute in seam
+        # scope is an uninstrumented compile path
+        return [self.finding(
+            ctx, node.lineno,
+            "direct jax.jit outside the compile seam — route through "
+            "core/compilecache.seam_jit / aot_compile so the first-call "
+            "compile is timed and classified hit/miss against the "
+            "persistent cache")
+            for node in ctx.of(ast.Attribute)
+            if node.attr == "jit" and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"]
+
+
+class _BoundedBlockingEngine(Rule):
+    """The shared R6/R7/R9 engine: unbounded ``put``/``get``/``wait``/
+    ``join`` on constructor-tracked receivers (incl. attribute-suffix
+    matches for deep chains) and bare ``time.sleep`` poll loops."""
+
+    where = "?"
+    contract = "?"
+    skip_r4_duplicates = False
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for call in _sleep_calls_in_while(ctx):
+            out.append(self.finding(
+                ctx, call.lineno,
+                f"bare time.sleep inside a while loop in {self.where} "
+                "— a poll loop with no deadline; use "
+                "Event.wait(timeout) or a bounded Condition.wait so "
+                "shutdown can interrupt it"))
+        keys, suffixes = ctx.bounded_receivers()
+        r4_blockers = ctx.blocking_receivers() \
+            if self.skip_r4_duplicates else set()
+        r4_active = self.skip_r4_duplicates \
+            and _in_dirs(ctx.relpath, BLOCKING_DIRS)
+        for node in ctx.of(ast.Call):
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _BOUNDED_METHODS
+                    and not _bounded(node, f.attr)):
+                continue
+            key = _recv_key(f.value)
+            suffix = None
+            if key is None and isinstance(f.value, ast.Attribute):
+                suffix = f.value.attr  # deep chains: match by suffix
+            elif key is not None:
+                suffix = key.split(".")[-1]
+            if (key not in keys) and (suffix not in suffixes):
+                continue
+            if r4_active and f.attr in ("join", "get") \
+                    and key in r4_blockers:
+                continue  # R4 already owns this finding
+            out.append(self.finding(
+                ctx, node.lineno,
+                f"unbounded blocking .{f.attr}() in {self.where} — "
+                f"{self.contract}: no worker thread may park forever; "
+                "pass a timeout (or non-blocking form) and fail fast "
+                "on expiry"))
+        return out
+
+
+class ServeBlocking(_BoundedBlockingEngine):
+    id = "R6"
+    scope_key = "serve"
+    where = "serve/"
+    contract = "the overload contract"
+
+
+class SearchBlocking(_BoundedBlockingEngine):
+    id = "R7"
+    scope_key = "search"
+    where = "search/"
+    contract = "the pipeline preemption contract"
+
+    def applies(self, ctx: FileContext) -> bool:
+        # a file lives in at most one of the serve/search scopes;
+        # serve wins the shared engine's rule id (legacy semantics)
+        return super().applies(ctx) and not ctx.scopes.get("serve")
+
+
+class ExtendedBlocking(_BoundedBlockingEngine):
+    id = "R9"
+    scope_key = "ext_blocking"
+    where = "thread/supervision code"
+    contract = "the no-thread-parks-forever contract"
+    skip_r4_duplicates = True
+
+    def applies(self, ctx: FileContext) -> bool:
+        # serve/search keep their own rule ids for the same engine
+        return super().applies(ctx) and not ctx.scopes.get("serve") \
+            and not ctx.scopes.get("search")
+
+
+class RawClock(Rule):
+    id = "R8"
+    scope_key = "timing"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ctx.of(ast.Attribute):
+            if node.attr in _R8_CLOCKS \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "time":
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"raw time.{node.attr} in a train/search/serve hot "
+                    "path — route timing through the telemetry seam "
+                    "(core/telemetry.py wall()/mono()/span()) or "
+                    "utils/profiling.py so the measurement reaches the "
+                    "registry/journal the artifacts stamp from"))
+        for node in ctx.of(ast.ImportFrom):
+            if node.module != "time":
+                continue
+            for alias in node.names:
+                if alias.name in _R8_CLOCKS:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"`from time import {alias.name}` in a "
+                        "train/search/serve hot path — the import-alias "
+                        "form of a raw clock read; use the telemetry "
+                        "seam (core/telemetry.py)"))
+        return out
+
+
+def RULES() -> list[Rule]:
+    return [BareExcept(), SwallowedBroadExcept(), DirectArtifactWrite(),
+            UntimedSupervisionBlock(), DirectJit(), ServeBlocking(),
+            SearchBlocking(), ExtendedBlocking(), RawClock()]

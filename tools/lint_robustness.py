@@ -1,313 +1,35 @@
 #!/usr/bin/env python
-"""AST robustness lint for the resilience contract (docs/RESILIENCE.md).
+"""Compatibility shim over ``tools/faalint`` — the robustness lint's
+historical entry point.
 
-Three rules, each a failure-handling discipline the resilience
-subsystem depends on:
+The rules R1–R8 that lived here migrated into the faalint framework as
+pluggable passes (``tools/faalint/rules_robustness.py``); this module
+keeps the legacy surface stable:
 
-R1  **no bare ``except:``** anywhere in the package — a bare except
-    swallows KeyboardInterrupt/SystemExit and (worse here) the typed
-    PreemptedError/CheckpointCorruptError signals the recovery paths
-    route on.
+* ``check_source(src, relpath, *_scope=...)`` — lint one source string
+  with the LEGACY rule set (R1–R8 only) and the same scope-forcing
+  keywords the rule-matrix tests use.
+* ``lint_tree()`` — the full-repo gate.  This now runs the COMPLETE
+  faalint rule set (R1–R9, C1–C3, D1–D3, T1–T3 + suppression/baseline
+  hygiene): ``make lint-robust`` is an alias for ``make lint``.
+* ``main()`` — delegates to the faalint CLI.
 
-R2  **no swallowed broad excepts**: an ``except Exception`` /
-    ``except BaseException`` handler must log (``logger.*``,
-    ``logging.*``, ``warnings.warn``) or re-``raise`` — silently eating
-    unknown failures is how a production stack loses its only evidence.
-
-R3  **no direct run-artifact writes**: inside the run-artifact layers
-    (``core/``, ``search/``, ``train/``, ``launch/``), ``json.dump``
-    and write-mode ``open(...)`` are reserved to the atomic helpers
-    (``write_json_atomic``, ``save_checkpoint``) — a bare write torn by
-    a crash is exactly the corruption the restore chain exists to
-    survive.  Append-mode logs and reads are fine.
-
-R4  **no untimed blocking** in ``core/``, ``launch/`` and ``search/``:
-    a ``Thread.join()`` or ``Queue.get()`` without a ``timeout=`` on a
-    variable bound from a ``Thread(...)``/``Queue(...)`` constructor in
-    the same file.  The watchdog subsystem (``core/watchdog.py``)
-    exists because dispatches wedge; an untimed join/get anywhere in
-    the supervision layers is the same hazard reintroduced — the
-    monitor becomes the thing that hangs.  (Receiver tracking is
-    constructor-based, so ``str.join`` / ``dict.get`` never match.)
-
-R5  **no direct ``jax.jit`` outside the compile seam** in ``train/``,
-    ``search/`` and ``serve/``: every jit entry point on those hot
-    paths must route through ``core/compilecache.py`` (``seam_jit`` /
-    ``aot_compile``) so its first-call compile is timed, classified
-    hit/miss against the persistent compilation cache, and stamped
-    into the run artifacts — an uninstrumented ``jax.jit`` silently
-    reintroduces the invisible 23-55 s compile tax the cache
-    subsystem exists to measure and kill.
-
-R6  **no unbounded blocking in the serving hot path** (``serve/``):
-    a ``Queue.put``/``Queue.get``, ``Event``/``Condition`` ``.wait``
-    or ``Thread.join`` without a timeout, or a bare ``time.sleep``
-    inside a ``while`` loop.  The policy server's overload contract is
-    that NO thread — HTTP handler, coalescing worker, supervision
-    loop — can park forever: a blocking admission put was exactly the
-    bug that held handler threads 30 s on a full queue, and a bare
-    sleep-poll loop has no deadline to fail fast on.  Receivers are
-    tracked from Thread/Queue/Event/Condition constructors in the same
-    file, both directly and by attribute suffix (``pending.event`` is
-    matched by the ``self.event = threading.Event()`` construction in
-    the request class).
-
-R7  **no unbounded blocking in the search pipeline** (``search/``):
-    the R6 rule set extended to the async actor/learner scheduler
-    (``search/pipeline.py``) and everything around it — an untimed
-    ``Queue.put``/``Queue.get``, ``Event``/``Condition`` ``.wait``,
-    ``Thread.join``, or a bare ``time.sleep`` poll loop in search
-    scope.  The pipeline's learner/actor threads coordinate through
-    queues under a preemption contract (SIGTERM must reach exit 77
-    promptly); one untimed wait turns a lost actor into a wedged
-    search.  Gated from day one so new pipeline code cannot regress.
-
-R8  **no raw clock reads in the train/search/serve hot paths**: a
-    ``time.time()`` / ``time.perf_counter()`` reference (call, alias,
-    or ``from time import time/perf_counter``) outside the telemetry/
-    profiling seam.  Timing that bypasses ``core/telemetry.py``
-    (``wall()``/``mono()``/``span()``) or ``utils/profiling.py`` is a
-    measurement the registry, the flight-recorder journal and the
-    artifact stamps can never see — exactly the private-schema
-    accounting drift the unified telemetry layer exists to end
-    (docs/OBSERVABILITY.md).  ``time.monotonic``/``time.sleep`` are not
-    timing evidence and stay unflagged.
-
-Suppress a finding (sparingly, with a reason nearby) by putting
-``robust: allow`` in a comment on the offending line.
-
-Exit status: 0 clean, 1 findings (printed one per line,
-``path:line: rule message``).  Wired as ``make lint-robust`` and run in
-``make test-t1``'s preamble.
+See docs/STATIC_ANALYSIS.md for the rule catalog; the per-rule
+rationale that used to live in this docstring moved there.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = "fast_autoaugment_tpu"
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# R3 scope: the layers that write run artifacts (checkpoints, trial
-# logs, result JSONs).  utils/ (ScalarWriter's append-mode JSONL,
-# tb_events' event files) and data/ (dataset downloads) are excluded —
-# their files are streams/caches, not resumable run state.
-ARTIFACT_DIRS = ("core", "search", "train", "launch")
-
-# R4 scope: the supervision/orchestration layers where an untimed
-# block turns a wedged dispatch into a wedged SUPERVISOR.  data/'s
-# prefetch worker is excluded: its consumer-side get() is the
-# documented pipeline backpressure, not supervision.
-BLOCKING_DIRS = ("core", "launch", "search")
-
-# R5 scope: the layers whose jit entry points must stay
-# cache-instrumented (core/compilecache.py seam).  ops/ and models/
-# are excluded: their jits are library/bench conveniences, not run
-# hot paths, and the seam wraps them at the train/search call sites.
-JIT_SEAM_DIRS = ("train", "search", "serve")
-
-# R6 scope: the serving layer, where EVERY thread must stay
-# deadline-bounded (handler threads, the coalescing worker, the
-# supervision loops) — docs/RESILIENCE.md "Serving under overload".
-SERVE_BLOCKING_DIRS = ("serve",)
-
-# R7 scope: the search layer — the async actor/learner pipeline
-# (search/pipeline.py) threads dispatches concurrently under the same
-# no-thread-parks-forever contract as serving.
-SEARCH_BLOCKING_DIRS = ("search",)
-
-# R8 scope: the hot paths whose timing must stay on the telemetry/
-# profiling seam (core/telemetry.py wall/mono/span; utils/profiling.py).
-# core/ and utils/ are the seam itself; launch/ is supervision, its
-# wall-clock heartbeats are protocol stamps, not measurements.
-TIMING_SEAM_DIRS = ("train", "search", "serve")
-
-#: the raw clock attributes R8 flags (time.monotonic is deadline
-#: plumbing, time.sleep is not a measurement — both stay legal)
-_R8_CLOCKS = {"time", "perf_counter"}
-
-# constructor names whose instances carry blocking .join()/.get()
-_THREAD_CTORS = {"Thread", "Timer"}
-_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
-                "JoinableQueue"}
-# R6 additionally tracks waitable sync primitives and flags .put()
-_WAIT_CTORS = {"Event", "Condition", "Barrier"}
-#: R6 blocking methods and the positional index their timeout lands at
-#: (Queue.put(item, block, timeout) -> a bare put(item) has ONE arg and
-#: still blocks forever; get()/join()/wait() block with ZERO args)
-_R6_METHODS = {"put": 1, "get": 0, "join": 0, "wait": 0}
-
-# (relative module path suffix, function name) pairs allowed to write
-# directly: THE atomic helpers themselves.
-ARTIFACT_WRITERS = {
-    ("core/checkpoint.py", "save_checkpoint"),
-    ("search/driver.py", "write_json_atomic"),
-}
-
-_LOG_NAMES = {"logger", "logging", "log", "warnings"}
-_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
-                "critical", "fatal"}
-
-
-class Finding:
-    def __init__(self, path: str, line: int, rule: str, msg: str):
-        self.path, self.line, self.rule, self.msg = path, line, rule, msg
-
-    def __repr__(self):
-        return f"{self.path}:{self.line}: {self.rule} {self.msg}"
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    names = []
-    if isinstance(t, ast.Name):
-        names = [t.id]
-    elif isinstance(t, ast.Tuple):
-        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
-    return any(n in ("Exception", "BaseException") for n in names)
-
-
-def _handles_failure(handler: ast.ExceptHandler) -> bool:
-    """True when the handler body logs, re-raises, or captures the
-    bound exception value (``except ... as e: err.append(e)`` — the
-    propagate-through-a-channel pattern); swallowed means the failure
-    is DISCARDED with no evidence."""
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if handler.name and isinstance(node, ast.Name) \
-                and node.id == handler.name \
-                and isinstance(node.ctx, ast.Load):
-            return True
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute):
-                base = f.value
-                if isinstance(base, ast.Name) and (
-                        base.id in _LOG_NAMES
-                        or base.id.startswith("log")) \
-                        and f.attr in _LOG_METHODS | {"warn"}:
-                    return True
-                if isinstance(base, ast.Name) and base.id == "warnings" \
-                        and f.attr == "warn":
-                    return True
-    return False
-
-
-def _write_mode(call: ast.Call) -> str | None:
-    """The mode string of an ``open`` call if it writes, else None."""
-    mode = None
-    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
-            and isinstance(call.args[1].value, str):
-        mode = call.args[1].value
-    for kw in call.keywords:
-        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
-                and isinstance(kw.value.value, str):
-            mode = kw.value.value
-    if mode and ("w" in mode or "x" in mode or "+" in mode):
-        return mode
-    return None
-
-
-def _recv_key(node) -> str | None:
-    """A trackable receiver: ``name`` or ``obj.attr`` (one level)."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
-        return f"{node.value.id}.{node.attr}"
-    return None
-
-
-def _ctor_name(call: ast.Call) -> str | None:
-    f = call.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return None
-
-
-def _blocking_receivers(tree) -> set[str]:
-    """Names (incl. ``self.x``) bound from Thread/Queue constructors in
-    this file — the receivers whose ``.join()``/``.get()`` block."""
-    out: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            if _ctor_name(node.value) in _THREAD_CTORS | _QUEUE_CTORS:
-                for tgt in node.targets:
-                    key = _recv_key(tgt)
-                    if key:
-                        out.add(key)
-        elif isinstance(node, ast.AnnAssign) and \
-                isinstance(node.value, ast.Call):
-            if _ctor_name(node.value) in _THREAD_CTORS | _QUEUE_CTORS:
-                key = _recv_key(node.target)
-                if key:
-                    out.add(key)
-    return out
-
-
-def _has_timeout(call: ast.Call) -> bool:
-    """True when the blocking call carries ANY argument — a positional
-    timeout (``join(5)``), ``get(False)`` (non-blocking), or an
-    explicit ``timeout=`` keyword.  Only the bare zero-arg form blocks
-    forever."""
-    return bool(call.args) or any(kw.arg == "timeout" for kw in call.keywords)
-
-
-def _r6_bounded(call: ast.Call, method: str) -> bool:
-    """Whether an R6 blocking call is bounded/non-blocking: positional
-    args past the method's payload slot (``put(item, False)``,
-    ``get(False)``, ``wait(0.1)``) or a ``block=``/``timeout=``
-    keyword."""
-    payload_args = _R6_METHODS[method]
-    if len(call.args) > payload_args:
-        return True
-    return any(kw.arg in ("timeout", "block") for kw in call.keywords)
-
-
-def _r6_receivers(tree) -> tuple[set[str], set[str]]:
-    """(receiver keys, attribute suffixes) bound from
-    Thread/Queue/Event/Condition constructors in this file.  The
-    suffix set matches cross-object uses — ``pending.event.wait()`` is
-    caught via the ``self.event = Event()`` construction elsewhere in
-    the file."""
-    ctors = _THREAD_CTORS | _QUEUE_CTORS | _WAIT_CTORS
-    keys: set[str] = set()
-    for node in ast.walk(tree):
-        value = None
-        targets = []
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            value, targets = node.value, node.targets
-        elif isinstance(node, ast.AnnAssign) and \
-                isinstance(node.value, ast.Call):
-            value, targets = node.value, [node.target]
-        if value is not None and _ctor_name(value) in ctors:
-            for tgt in targets:
-                key = _recv_key(tgt)
-                if key:
-                    keys.add(key)
-    suffixes = {k.split(".")[-1] for k in keys}
-    return keys, suffixes
-
-
-def _sleep_in_while(tree) -> list[ast.Call]:
-    """``time.sleep`` calls lexically inside a ``while`` body — a poll
-    loop with no deadline."""
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.While):
-            continue
-        for child in ast.walk(node):
-            if isinstance(child, ast.Call) \
-                    and isinstance(child.func, ast.Attribute) \
-                    and child.func.attr == "sleep" \
-                    and isinstance(child.func.value, ast.Name) \
-                    and child.func.value.id == "time":
-                hits.append(child)
-    return hits
+from faalint import engine as _engine  # noqa: E402
+from faalint.engine import (  # noqa: E402,F401 — legacy re-exports
+    ARTIFACT_DIRS, BLOCKING_DIRS, JIT_SEAM_DIRS, LEGACY_RULE_IDS, PACKAGE,
+    REPO, SEARCH_BLOCKING_DIRS, SERVE_BLOCKING_DIRS, TIMING_SEAM_DIRS,
+    Finding)
 
 
 def check_source(src: str, relpath: str,
@@ -317,203 +39,33 @@ def check_source(src: str, relpath: str,
                  serve_scope: bool | None = None,
                  search_scope: bool | None = None,
                  timing_scope: bool | None = None) -> list[Finding]:
-    """Lint one file's source.  `artifact_scope` forces R3 on/off,
-    `blocking_scope` forces R4 on/off, `jit_scope` forces R5 on/off,
-    `serve_scope` forces R6 on/off, `search_scope` forces R7 on/off,
-    `timing_scope` forces R8 on/off (None = derive from `relpath`)."""
-    findings: list[Finding] = []
-    lines = src.splitlines()
-
-    def allowed(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and "robust: allow" in lines[lineno - 1]
-
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding(relpath, e.lineno or 0, "R0", f"syntax error: {e.msg}")]
-
-    def _in_dirs(dirs) -> bool:
-        norm = relpath.replace(os.sep, "/")
-        return any(
-            f"/{d}/" in f"/{norm}" or norm.startswith(f"{d}/")
-            for d in (f"{PACKAGE}/{a}" for a in dirs))
-
-    if artifact_scope is None:
-        artifact_scope = _in_dirs(ARTIFACT_DIRS)
-    if blocking_scope is None:
-        blocking_scope = _in_dirs(BLOCKING_DIRS)
-    if jit_scope is None:
-        jit_scope = _in_dirs(JIT_SEAM_DIRS)
-    if serve_scope is None:
-        serve_scope = _in_dirs(SERVE_BLOCKING_DIRS)
-    if search_scope is None:
-        search_scope = _in_dirs(SEARCH_BLOCKING_DIRS)
-    if timing_scope is None:
-        timing_scope = _in_dirs(TIMING_SEAM_DIRS)
-    blockers = _blocking_receivers(tree) if blocking_scope else set()
-    # R6 (serve/) and R7 (search/) share one rule engine; a file lives
-    # in at most one of the two scopes
-    bounded_rule = "R6" if serve_scope else ("R7" if search_scope else None)
-    bounded_where = "serve/" if serve_scope else "search/"
-    bounded_contract = (
-        "the overload contract" if serve_scope
-        else "the pipeline preemption contract")
-    r6_keys: set[str] = set()
-    r6_suffixes: set[str] = set()
-    if bounded_rule:
-        r6_keys, r6_suffixes = _r6_receivers(tree)
-        for call in _sleep_in_while(tree):
-            if not allowed(call.lineno):
-                findings.append(Finding(
-                    relpath, call.lineno, bounded_rule,
-                    f"bare time.sleep inside a while loop in "
-                    f"{bounded_where} — a poll loop with no deadline; "
-                    "use Event.wait(timeout) or a bounded "
-                    "Condition.wait so shutdown can interrupt it"))
-
-    # enclosing-function map for the R3 allowlist
-    func_of: dict[int, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for child in ast.walk(node):
-                if hasattr(child, "lineno"):
-                    func_of.setdefault(child.lineno, node.name)
-
-    norm = relpath.replace(os.sep, "/")
-
-    def is_allowlisted_writer(lineno: int) -> bool:
-        fn = func_of.get(lineno, "")
-        return any(norm.endswith(suffix) and fn == name
-                   for suffix, name in ARTIFACT_WRITERS)
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler):
-            if allowed(node.lineno):
-                continue
-            if node.type is None:
-                findings.append(Finding(
-                    relpath, node.lineno, "R1",
-                    "bare `except:` swallows SystemExit/KeyboardInterrupt "
-                    "and the typed resilience signals — name the "
-                    "exceptions"))
-            elif _is_broad(node) and not _handles_failure(node):
-                findings.append(Finding(
-                    relpath, node.lineno, "R2",
-                    "broad `except Exception` neither logs nor re-raises "
-                    "— a swallowed failure leaves no evidence"))
-        elif artifact_scope and isinstance(node, ast.Call):
-            if allowed(node.lineno) or is_allowlisted_writer(node.lineno):
-                continue
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr == "dump" \
-                    and isinstance(f.value, ast.Name) and f.value.id == "json":
-                findings.append(Finding(
-                    relpath, node.lineno, "R3",
-                    "direct json.dump to a run artifact — use "
-                    "write_json_atomic (fsync + rename) so a crash "
-                    "cannot tear the file"))
-            elif isinstance(f, ast.Name) and f.id == "open":
-                mode = _write_mode(node)
-                if mode:
-                    findings.append(Finding(
-                        relpath, node.lineno, "R3",
-                        f"direct open(..., {mode!r}) write to a run "
-                        "artifact — route through write_json_atomic / "
-                        "save_checkpoint"))
-        if blockers and isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr in ("join", "get") \
-                    and _recv_key(f.value) in blockers \
-                    and not _has_timeout(node) \
-                    and not allowed(node.lineno):
-                findings.append(Finding(
-                    relpath, node.lineno, "R4",
-                    f"untimed blocking .{f.attr}() on a Thread/Queue — "
-                    "pass a timeout (the watchdog contract: supervision "
-                    "code must never be able to hang forever)"))
-        if bounded_rule and isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr in _R6_METHODS \
-                    and not _r6_bounded(node, f.attr) \
-                    and not allowed(node.lineno):
-                key = _recv_key(f.value)
-                suffix = None
-                if key is None and isinstance(f.value, ast.Attribute):
-                    suffix = f.value.attr  # deep chains: match by suffix
-                elif key is not None:
-                    suffix = key.split(".")[-1]
-                if (key in r6_keys) or (suffix in r6_suffixes):
-                    findings.append(Finding(
-                        relpath, node.lineno, bounded_rule,
-                        f"unbounded blocking .{f.attr}() in "
-                        f"{bounded_where} — {bounded_contract}: no "
-                        "worker thread may park forever; pass a timeout "
-                        "(or non-blocking form) and fail fast on expiry"))
-        if timing_scope and isinstance(node, ast.Attribute) \
-                and node.attr in _R8_CLOCKS \
-                and isinstance(node.value, ast.Name) \
-                and node.value.id == "time" \
-                and not allowed(node.lineno):
-            findings.append(Finding(
-                relpath, node.lineno, "R8",
-                f"raw time.{node.attr} in a train/search/serve hot path "
-                "— route timing through the telemetry seam "
-                "(core/telemetry.py wall()/mono()/span()) or "
-                "utils/profiling.py so the measurement reaches the "
-                "registry/journal the artifacts stamp from"))
-        if timing_scope and isinstance(node, ast.ImportFrom) \
-                and node.module == "time" \
-                and not allowed(node.lineno):
-            for alias in node.names:
-                if alias.name in _R8_CLOCKS:
-                    findings.append(Finding(
-                        relpath, node.lineno, "R8",
-                        f"`from time import {alias.name}` in a "
-                        "train/search/serve hot path — the import-alias "
-                        "form of a raw clock read; use the telemetry "
-                        "seam (core/telemetry.py)"))
-        if jit_scope and isinstance(node, ast.Attribute) \
-                and node.attr == "jit" \
-                and isinstance(node.value, ast.Name) \
-                and node.value.id == "jax" \
-                and not allowed(node.lineno):
-            # catches direct calls, functools.partial(jax.jit, ...) AND
-            # @jax.jit decorators: any reference to the attribute in
-            # seam scope is an uninstrumented compile path
-            findings.append(Finding(
-                relpath, node.lineno, "R5",
-                "direct jax.jit outside the compile seam — route "
-                "through core/compilecache.seam_jit / aot_compile so "
-                "the first-call compile is timed and classified "
-                "hit/miss against the persistent cache"))
-    return findings
+    """Lint one file's source with the legacy R1–R8 rule set.  Each
+    ``*_scope`` kwarg forces that rule family on/off (None = derive
+    from `relpath`), exactly as before the faalint migration."""
+    overrides = {
+        "artifact": artifact_scope,
+        "blocking": blocking_scope,
+        "jit": jit_scope,
+        "serve": serve_scope,
+        "search": search_scope,
+        "timing": timing_scope,
+    }
+    return _engine.check_source(src, relpath, overrides=overrides,
+                                rule_ids=LEGACY_RULE_IDS)
 
 
 def lint_tree(root: str = REPO) -> list[Finding]:
-    findings: list[Finding] = []
-    pkg_root = os.path.join(root, PACKAGE)
-    for dirpath, _dirnames, filenames in os.walk(pkg_root):
-        if "__pycache__" in dirpath:
-            continue
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            with open(path) as fh:
-                findings.extend(check_source(fh.read(), rel))
-    return findings
+    """The full-repo gate — now the complete faalint rule set (the
+    robustness rules plus the concurrency/dispatch/determinism passes
+    and the suppression/baseline hygiene checks)."""
+    findings = _engine.lint_tree(root)
+    return [f for f in findings if not f.baselined]
 
 
 def main(argv=None) -> int:
-    findings = lint_tree()
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"lint-robust: {len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    print("lint-robust: clean")
-    return 0
+    from faalint.cli import main as faalint_main
+
+    return faalint_main(argv)
 
 
 if __name__ == "__main__":
