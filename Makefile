@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-compile bench-pipeline trace status clean reproduce
+.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-compile bench-pipeline trace status clean reproduce
 
 # telemetry journal dir for the trace/status targets (override:
 #   make trace TELEMETRY=/shared/run TRACE_OUT=overlap.json)
@@ -74,6 +74,14 @@ bench-serve:
 # (docs/RESILIENCE.md "Serving under overload")
 bench-overload:
 	python tools/bench_serve.py --overload
+
+# serving-plane bench: a real router over N serve_cli replicas (two
+# policies resident via tenancy), routed vs direct arms as PAIRED
+# ALTERNATING rounds with per-arm medians (the 1-core A/B discipline),
+# affinity hit rate + router topology stamped in the JSON line
+# (docs/SERVING.md "Measuring the plane")
+bench-router:
+	python tools/bench_router.py
 
 # cold/warm compile-tax bench: the same train-step workload in two
 # fresh processes sharing one FAA_COMPILE_CACHE dir — the warm process

@@ -194,12 +194,22 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
-            if self.state != "closed":
+            reopened = self.state != "closed"
+            if reopened:
                 logger.warning("circuit breaker CLOSED (probe succeeded)")
             self.state = "closed"
             self.consecutive_failures = 0
             self._opened_at = None
             self._probe_inflight = False
+        if reopened:
+            # scrape-visible state for the autoscaler/router consumers
+            # (docs/OBSERVABILITY.md): 1 while OPEN, 0 when closed
+            from fast_autoaugment_tpu.core import telemetry
+
+            telemetry.registry().gauge(
+                "faa_breaker_open",
+                "1 while the circuit breaker is OPEN, else 0",
+                breaker=self.name).set(0.0)
 
     def record_failure(self) -> None:
         if not self.enabled:
@@ -229,6 +239,10 @@ class CircuitBreaker:
             "faa_breaker_fires_total",
             "circuit-breaker transitions into OPEN",
             breaker=self.name).inc()
+        telemetry.registry().gauge(
+            "faa_breaker_open",
+            "1 while the circuit breaker is OPEN, else 0",
+            breaker=self.name).set(1.0)
         telemetry.emit("breaker_fire", self.name, fires=fires,
                        consecutive_failures=failures,
                        cooldown_s=self.cooldown_s)

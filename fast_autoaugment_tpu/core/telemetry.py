@@ -115,6 +115,10 @@ EVENT_TYPES = frozenset({
     "preempt",        # a preemption/hang was honored (exit-77 path)
     "phase",          # a phase window (phase-1 fold train, phase-2 fold)
     "mark",           # free-form marker (tools, tests)
+    "rotation",       # a router ejected / re-admitted a serving replica
+    "tenant",         # multi-policy tenancy admit/evict/warm (serve LRU)
+    "scale_up",       # autoscaler grew the replica fleet (evidence inline)
+    "scale_down",     # autoscaler shrank the replica fleet
 })
 
 

@@ -50,6 +50,7 @@ the clean-weather PR-7 stream.
 from __future__ import annotations
 
 import collections
+import hashlib
 import threading
 import time
 from typing import Sequence
@@ -63,8 +64,9 @@ from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = ["AotPolicyApplier", "PolicyServer", "ServeError",
            "ServerOverloadedError", "ServerStoppedError",
-           "DeadlineExpiredError", "CircuitOpenError",
-           "DEFAULT_SHAPES", "pick_shape"]
+           "DeadlineExpiredError", "TenantNotResidentError",
+           "CircuitOpenError", "TenantPool",
+           "DEFAULT_SHAPES", "pick_shape", "policy_digest"]
 
 logger = get_logger("faa_tpu.serve")
 
@@ -90,6 +92,22 @@ def pick_shape(shapes: Sequence[int], n: int) -> int:
             return s
     raise ValueError(f"batch of {n} exceeds the largest AOT shape "
                      f"{max(shapes)} — chunk before dispatching")
+
+
+def policy_digest(policy) -> str:
+    """The canonical 12-hex policy identity: sha256 over the float32
+    ``[num_sub, num_op, 3]`` tensor's shape and bytes.
+
+    ONE digest names one AOT-warm policy everywhere in the serving
+    plane: the ``X-FAA-Policy-Digest`` request header selects the
+    tenant, the tenancy LRU keys residents by it, and the router's
+    rendezvous hash maps it to the replicas most likely to hold that
+    tenant warm (docs/SERVING.md)."""
+    arr = np.ascontiguousarray(np.asarray(policy, np.float32))
+    h = hashlib.sha256()
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()[:12]
 
 
 class AotPolicyApplier:
@@ -128,6 +146,9 @@ class AotPolicyApplier:
             raise ValueError(
                 f"policy must be [num_sub, num_op, 3], got {policy.shape}")
         self.policy = policy
+        #: the serving-plane identity of this applier's policy (tenancy
+        #: LRU key, router affinity key, X-FAA-Policy-Digest value)
+        self.digest = policy_digest(np.asarray(policy))
         self.num_sub = int(policy.shape[0])
         if dispatch == "auto":
             dispatch = "exact" if self.num_sub == 1 else "grouped"
@@ -327,16 +348,160 @@ class DeadlineExpiredError(ServeError):
     completed hopelessly late."""
 
 
+class TenantNotResidentError(ServeError):
+    """The request named a policy digest with no resident AOT-warm
+    applier on this replica.  The HTTP layer answers a structured 503
+    (``tenant_cold``) — and, when a warm recipe exists, kicks a
+    BACKGROUND warm so a later retry hits the tenant resident; the
+    router treats it as a failover signal (another replica may hold
+    the tenant warm).  Carries the requested `digest` and the
+    replica's `resident` digest list for those decisions."""
+
+    def __init__(self, msg: str, digest: str | None = None,
+                 resident: Sequence[str] = ()):
+        super().__init__(msg)
+        self.digest = digest
+        self.resident = tuple(resident)
+
+
+class TenantPool:
+    """The multi-policy tenancy LRU: resident AOT-warm appliers keyed
+    by policy digest (the 1 -> N generalization of PR-8 hot reload).
+
+    Admission (:meth:`admit`) takes an applier the caller AOT-warmed
+    OFF TO THE SIDE — cold policies never compile on the dispatch
+    path.  Over ``capacity`` the least-recently-used digest starts
+    RETIRING: invisible to NEW submissions immediately (the router
+    stops seeing it resident), while requests already queued under it
+    still dispatch on its applier — eviction takes memory effect only
+    when the server worker :meth:`sweep`\\ s at a dispatch boundary
+    and the tenant's queued work has drained.  An in-flight or queued
+    request NEVER loses its applier mid-dispatch.
+
+    Thread-safe: HTTP handler threads look up, a warm thread admits,
+    the server worker sweeps."""
+
+    def __init__(self, capacity: int, server_id: str = "0"):
+        self.capacity = max(1, int(capacity))
+        self._server_id = str(server_id)
+        self._lock = threading.Lock()
+        self._resident: collections.OrderedDict[str, object] = \
+            collections.OrderedDict()
+        self._retiring: dict[str, object] = {}
+        self._inflight: dict[str, int] = {}
+        reg = telemetry.registry()
+        self._events = {name: reg.counter(
+            "faa_tenant_events_total",
+            "tenancy events (admit/evict/hit/miss) per server",
+            event=name, server=self._server_id)
+            for name in ("admit", "evict", "hit", "miss")}
+        self._resident_gauge = reg.gauge(
+            "faa_tenant_resident", "tenants resident in the LRU",
+            server=self._server_id)
+
+    def lookup_submit(self, digest: str):
+        """Resident-only lookup (MRU bump) for NEW submissions — a
+        retiring tenant reads as not resident, so fresh traffic routes
+        elsewhere while its queued work drains."""
+        with self._lock:
+            ap = self._resident.get(digest)
+            if ap is not None:
+                self._resident.move_to_end(digest)
+                self._events["hit"].inc()
+                return ap
+            self._events["miss"].inc()
+            return None
+
+    def lookup_dispatch(self, digest: str):
+        """Resident-or-retiring lookup for the dispatch boundary —
+        queued requests under a retiring tenant still get its applier."""
+        with self._lock:
+            ap = self._resident.get(digest)
+            if ap is None:
+                ap = self._retiring.get(digest)
+            return ap
+
+    def admit(self, digest: str, applier) -> list[str]:
+        """Flip an AOT-warm applier into the LRU; returns the digests
+        that started retiring (evicted from residency) as a result."""
+        with self._lock:
+            already = digest in self._resident
+            self._resident[digest] = applier
+            self._resident.move_to_end(digest)
+            self._retiring.pop(digest, None)  # a re-admit resurrects
+            evicted: list[str] = []
+            while len(self._resident) > self.capacity:
+                old_digest, old_ap = self._resident.popitem(last=False)
+                self._retiring[old_digest] = old_ap
+                evicted.append(old_digest)
+            if not already:
+                self._events["admit"].inc()
+            if evicted:
+                self._events["evict"].inc(len(evicted))
+            self._resident_gauge.set(len(self._resident))
+            n_resident = len(self._resident)
+        for old in evicted:
+            telemetry.emit("tenant", f"serve{self._server_id}",
+                           action="evict", digest=old)
+        telemetry.emit("tenant", f"serve{self._server_id}",
+                       action="admit", digest=digest,
+                       resident=n_resident)
+        return evicted
+
+    # -- queued-work accounting (what makes retirement safe) ----------
+
+    def track_submit(self, digest: str) -> None:
+        with self._lock:
+            self._inflight[digest] = self._inflight.get(digest, 0) + 1
+
+    def track_done(self, digest: str) -> None:
+        with self._lock:
+            n = self._inflight.get(digest, 0) - 1
+            if n <= 0:
+                self._inflight.pop(digest, None)
+            else:
+                self._inflight[digest] = n
+
+    def sweep(self) -> list[str]:
+        """Dispatch-boundary eviction: release retiring appliers whose
+        queued work has fully drained.  Called by the server worker
+        BETWEEN dispatches — never while one is in flight."""
+        with self._lock:
+            dead = [d for d in self._retiring
+                    if self._inflight.get(d, 0) <= 0]
+            for d in dead:
+                del self._retiring[d]
+        return dead
+
+    def resident_digests(self) -> list[str]:
+        """Resident digests, LRU-first / MRU-last."""
+        with self._lock:
+            return list(self._resident)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "resident": list(self._resident),
+                "retiring": sorted(self._retiring),
+                "admits": int(self._events["admit"].value),
+                "evicts": int(self._events["evict"].value),
+                "hits": int(self._events["hit"].value),
+                "misses": int(self._events["miss"].value),
+            }
+
+
 class _Pending:
     """One in-flight request: `n` images, completion event, result or
     error, submit/done walls for the latency record, and an optional
     absolute deadline (``mono()`` seconds)."""
 
     __slots__ = ("images", "keys", "event", "result", "error",
-                 "t_submit", "t_done", "deadline")
+                 "t_submit", "t_done", "deadline", "digest")
 
     def __init__(self, images: np.ndarray, keys: np.ndarray | None,
-                 deadline: float | None = None):
+                 deadline: float | None = None,
+                 digest: str | None = None):
         self.images = images
         self.keys = keys
         self.event = threading.Event()
@@ -345,6 +510,9 @@ class _Pending:
         self.t_submit = mono()
         self.t_done = 0.0
         self.deadline = deadline
+        # tenancy: the policy digest this request is pinned to (None =
+        # the server's pinned default applier — the historical stream)
+        self.digest = digest
 
     @property
     def n(self) -> int:
@@ -482,7 +650,14 @@ class PolicyServer:
       until a half-open probe succeeds;
     - **hot reload**: :meth:`swap_applier` atomically swaps in a new
       (pre-warmed) applier between dispatches — no dropped requests,
-      no half-policy batch (each dispatch binds ONE applier).
+      no half-policy batch (each dispatch binds ONE applier);
+    - **multi-policy tenancy**: ``tenant_capacity`` > 0 arms a
+      :class:`TenantPool` LRU of resident AOT-warm appliers keyed by
+      policy digest; ``submit(..., digest=...)`` selects the tenant,
+      :meth:`warm_tenant` admits one warmed off to the side, batches
+      never mix tenants, and eviction takes effect only at dispatch
+      boundaries (docs/SERVING.md).  0 (default) = the single-policy
+      bit-for-bit stream.
 
     The ``FAA_FAULT`` verbs ``serve_error@dispatch=N`` and
     ``serve_slow@dispatch=N,factor=F`` are consulted at the dispatch
@@ -497,7 +672,8 @@ class PolicyServer:
                  lifo_depth: int = 0, lifo_age_ms: float = 0.0,
                  breaker_threshold: int = 0,
                  breaker_cooldown_s: float = 5.0,
-                 dispatch_timeout_s: float = 0.0):
+                 dispatch_timeout_s: float = 0.0,
+                 tenant_capacity: int = 0):
         self.applier = applier
         self.max_batch = int(max_batch or applier.max_batch)
         if self.max_batch > applier.max_batch:
@@ -554,6 +730,23 @@ class PolicyServer:
         self.breaker = CircuitBreaker(threshold=breaker_threshold,
                                       cooldown_s=breaker_cooldown_s,
                                       name=f"serve{self._server_id}")
+        # multi-policy tenancy (docs/SERVING.md): an LRU of resident
+        # AOT-warm appliers keyed by policy digest.  0 = off — the
+        # single-policy bit-for-bit historical stream.  The __init__
+        # applier is the PINNED default tenant (never evicted); its
+        # digest answers requests that name it explicitly.
+        self.tenant_capacity = int(tenant_capacity)
+        self._tenants = (TenantPool(self.tenant_capacity, self._server_id)
+                         if self.tenant_capacity > 0 else None)
+        self.default_digest = getattr(applier, "digest", None)
+        # per-tenant serve counters, cached per digest; written only
+        # by the worker thread (reads via registry snapshots)
+        self._tenant_ctrs: dict[str, tuple] = {}
+        # scrape-visible queue depth for the autoscaler (the PR-10
+        # telemetry consumer): updated at admission and collection
+        self._qdepth_gauge = reg.gauge(
+            "faa_serve_queue_depth", "requests queued awaiting dispatch",
+            server=self._server_id)
         #: grace past a request's deadline that result() still waits —
         #: covers the shed pass delivering the typed error
         self.deadline_grace_s = 1.0
@@ -632,15 +825,21 @@ class PolicyServer:
 
     def submit(self, images: np.ndarray,
                keys: np.ndarray | None = None, *,
-               deadline_ms: float | None = None) -> _Pending:
+               deadline_ms: float | None = None,
+               digest: str | None = None) -> _Pending:
         """Queue ``images [n, H, W, C]`` (or one ``[H, W, C]`` image).
 
         `keys` (``[n, 2]`` uint32) pins the per-image PRNG streams —
         the reproducible-serving contract; None lets the server derive
         them.  `deadline_ms` (relative; default the server's
         ``default_deadline_ms``) stamps the deadline after which the
-        request is shed instead of dispatched.  Returns a pending
-        handle for :meth:`result`.  NEVER blocks: a full queue raises
+        request is shed instead of dispatched.  `digest` pins the
+        request to a resident TENANT policy (``X-FAA-Policy-Digest``);
+        None — or the default applier's own digest — serves the pinned
+        default.  A digest with no resident applier raises the typed
+        :class:`TenantNotResidentError` immediately (the router's
+        failover signal).  Returns a pending handle for
+        :meth:`result`.  NEVER blocks: a full queue raises
         :class:`ServerOverloadedError`, a stopped/draining server
         :class:`ServerStoppedError`, an open breaker
         :class:`~fast_autoaugment_tpu.core.resilience.CircuitOpenError`
@@ -655,6 +854,19 @@ class PolicyServer:
             raise ValueError(
                 f"request of {n} images exceeds max_batch "
                 f"{self.max_batch} — split client-side")
+        if digest is not None and digest == self.default_digest:
+            digest = None  # the pinned default serves its own digest
+        if digest is not None:
+            if self._tenants is None:
+                raise TenantNotResidentError(
+                    f"policy {digest} requested but this replica serves "
+                    f"a single policy (tenancy disabled)", digest,
+                    resident=([self.default_digest]
+                              if self.default_digest else ()))
+            if self._tenants.lookup_submit(digest) is None:
+                raise TenantNotResidentError(
+                    f"policy {digest} is not resident on this replica",
+                    digest, resident=self._tenants.resident_digests())
         if self._closed.is_set():
             self._ctr["shed_stopped"].inc()
             telemetry.emit("shed", f"serve{self._server_id}",
@@ -676,7 +888,7 @@ class PolicyServer:
             deadline_ms = self.default_deadline_ms
         deadline = (None if deadline_ms is None
                     else mono() + float(deadline_ms) / 1e3)
-        pending = _Pending(images, keys, deadline)
+        pending = _Pending(images, keys, deadline, digest)
         if not self._q.offer(pending):
             self._ctr["shed_overload"].inc()
             telemetry.emit("shed", f"serve{self._server_id}",
@@ -685,6 +897,11 @@ class PolicyServer:
                 f"queue full ({self.queue_depth} requests) — shedding",
                 retry_after_s=max(0.05, self.max_wait_ms / 1e3))
         self._ctr["admitted"].inc()
+        if self._tenants is not None and digest is not None:
+            # retirement safety: a tenant with queued work is never
+            # swept (the dispatch-boundary eviction contract)
+            self._tenants.track_submit(digest)
+        self._qdepth_gauge.set(len(self._q))
         return pending
 
     def result(self, pending: _Pending, timeout: float = 60.0) -> np.ndarray:
@@ -706,9 +923,11 @@ class PolicyServer:
 
     def augment(self, images: np.ndarray, keys: np.ndarray | None = None,
                 timeout: float = 60.0,
-                deadline_ms: float | None = None) -> np.ndarray:
+                deadline_ms: float | None = None,
+                digest: str | None = None) -> np.ndarray:
         """Submit + wait — the one-call client path."""
-        return self.result(self.submit(images, keys, deadline_ms=deadline_ms),
+        return self.result(self.submit(images, keys, deadline_ms=deadline_ms,
+                                       digest=digest),
                            timeout=timeout)
 
     # ------------------------------------------------------ hot reload
@@ -728,23 +947,10 @@ class PolicyServer:
         The new applier must serve the same request contract: equal
         image/channels, the SAME dispatch mode (a request's key shape
         depends on it), and a ``max_batch`` covering the server's."""
-        old = self.applier
-        if (new_applier.image, new_applier.channels) != (old.image,
-                                                         old.channels):
-            raise ValueError(
-                f"reload changes served geometry "
-                f"{(old.image, old.channels)} -> "
-                f"{(new_applier.image, new_applier.channels)}")
-        if new_applier.dispatch != old.dispatch:
-            raise ValueError(
-                f"reload changes dispatch mode {old.dispatch!r} -> "
-                f"{new_applier.dispatch!r} — queued keys would not fit")
-        if new_applier.max_batch < self.max_batch:
-            raise ValueError(
-                f"new applier's largest AOT shape {new_applier.max_batch} "
-                f"is below the server's max_batch {self.max_batch}")
+        self._validate_applier(new_applier, verb="reload")
         with self._lock:
             self.applier = new_applier
+            self.default_digest = getattr(new_applier, "digest", None)
             self._ctr["reloads"].inc()
             n = self.reloads
         telemetry.emit("reload", f"serve{self._server_id}", reloads=n,
@@ -752,6 +958,86 @@ class PolicyServer:
         logger.info("hot reload #%d: applier swapped (%d sub-policies)",
                     n, new_applier.num_sub)
         return {"reloads": n, "num_sub": new_applier.num_sub}
+
+    # ---------------------------------------------------------- tenancy
+
+    def _validate_applier(self, new_applier, verb: str = "tenant") -> None:
+        """The shared serving contract every applier entering this
+        server must satisfy (reload AND tenant admission): equal
+        geometry, the same dispatch mode, AOT coverage of max_batch."""
+        old = self.applier
+        if (new_applier.image, new_applier.channels) != (old.image,
+                                                         old.channels):
+            raise ValueError(
+                f"{verb} changes served geometry "
+                f"{(old.image, old.channels)} -> "
+                f"{(new_applier.image, new_applier.channels)}")
+        if new_applier.dispatch != old.dispatch:
+            raise ValueError(
+                f"{verb} changes dispatch mode {old.dispatch!r} -> "
+                f"{new_applier.dispatch!r} — queued keys would not fit")
+        if new_applier.max_batch < self.max_batch:
+            raise ValueError(
+                f"new applier's largest AOT shape {new_applier.max_batch} "
+                f"is below the server's max_batch {self.max_batch}")
+
+    @property
+    def tenancy_enabled(self) -> bool:
+        return self._tenants is not None
+
+    def resident_tenants(self) -> list[str]:
+        """Resident tenant digests (LRU-first), default tenant
+        excluded — it is pinned, not pooled."""
+        return ([] if self._tenants is None
+                else self._tenants.resident_digests())
+
+    def warm_tenant(self, new_applier) -> dict:
+        """Admit an AOT-warm applier as a resident TENANT — the 1 -> N
+        generalization of :meth:`swap_applier`.
+
+        The caller builds (and thereby AOT-warms) `new_applier` OFF TO
+        THE SIDE — a cold policy never compiles on the dispatch path
+        and warm tenants keep dispatching throughout.  This method
+        validates the serving contract and flips the applier into the
+        LRU.  Over capacity the least-recently-used tenant starts
+        retiring: invisible to new submissions at once, its memory
+        released at the next dispatch boundary once its queued work
+        has drained (:class:`TenantPool`)."""
+        if self._tenants is None:
+            raise RuntimeError(
+                "tenancy is disabled on this server (tenant_capacity=0)")
+        digest = getattr(new_applier, "digest", None)
+        if not digest:
+            raise ValueError("tenant applier carries no policy digest")
+        if digest == self.default_digest:
+            raise ValueError(
+                f"policy {digest} is the pinned default tenant — "
+                "use swap_applier/reload to change it")
+        self._validate_applier(new_applier, verb="tenant admit")
+        evicted = self._tenants.admit(digest, new_applier)
+        info = {"digest": digest, "evicted": evicted,
+                "resident": self._tenants.resident_digests()}
+        logger.info("tenant %s admitted (%d sub-policies; evicted: %s)",
+                    digest, getattr(new_applier, "num_sub", 0),
+                    ",".join(evicted) or "-")
+        return info
+
+    def _tenant_done(self, p: _Pending) -> None:
+        if self._tenants is not None and p.digest is not None:
+            self._tenants.track_done(p.digest)
+
+    def _tenant_counters(self, digest: str) -> tuple:
+        c = self._tenant_ctrs.get(digest)
+        if c is None:
+            reg = telemetry.registry()
+            c = (reg.counter("faa_tenant_requests_total",
+                             "requests served per tenant",
+                             digest=digest, server=self._server_id),
+                 reg.counter("faa_tenant_images_total",
+                             "images served per tenant",
+                             digest=digest, server=self._server_id))
+            self._tenant_ctrs[digest] = c
+        return c
 
     # ---------------------------------------------------------- worker
 
@@ -770,6 +1056,7 @@ class PolicyServer:
             f"({p.n} images) — request shed before dispatch")
         p.t_done = now
         p.event.set()
+        self._tenant_done(p)
         self._ctr["expired"].inc()
         telemetry.emit("shed", f"serve{self._server_id}",
                        reason="deadline_expired", n=int(p.n))
@@ -777,9 +1064,12 @@ class PolicyServer:
     def _collect(self, first: _Pending) -> list[_Pending]:
         """Coalesce: up to ``max_batch`` images or ``max_wait_ms`` after
         the FIRST request of the batch arrived.  Expired requests are
-        shed as they are encountered and never join the batch."""
+        shed as they are encountered and never join the batch.  A batch
+        holds requests for ONE tenant digest only — each dispatch binds
+        exactly one applier (the reload/tenancy atomicity contract)."""
         batch: list[_Pending] = []
         count = 0
+        digest = first.digest
         now = mono()
         if first.expired(now):
             self._shed(first, now)
@@ -798,6 +1088,14 @@ class PolicyServer:
             if nxt.expired(now):
                 self._shed(nxt, now)
                 continue
+            if not batch:
+                digest = nxt.digest  # first admitted member sets the tenant
+            elif nxt.digest != digest:
+                # one tenant per dispatch: carry the first request of
+                # the NEXT tenant whole (FIFO preserved — the carry is
+                # taken first at the next collection)
+                self._carry = nxt
+                break
             if count + nxt.n > self.max_batch:
                 # never split a request: carry it whole to the next
                 # dispatch (FIFO preserved — the carry is taken first)
@@ -805,6 +1103,7 @@ class PolicyServer:
                 break
             batch.append(nxt)
             count += nxt.n
+        self._qdepth_gauge.set(len(self._q))
         return batch
 
     def _fail_batch(self, batch: list[_Pending], err: BaseException) -> None:
@@ -813,6 +1112,7 @@ class PolicyServer:
             p.error = err
             p.t_done = done
             p.event.set()
+            self._tenant_done(p)
 
     def _injected_fault(self) -> tuple[str, float] | None:
         """Consult the FAA_FAULT serve verbs with the 1-based dispatch
@@ -825,7 +1125,25 @@ class PolicyServer:
         return plan.serve_fault(self._dispatch_attempts)
 
     def _dispatch(self, batch: list[_Pending]) -> None:
-        applier = self.applier  # ONE applier per dispatch (reload seam)
+        # ONE applier per dispatch (the reload AND tenancy seam): the
+        # binding is taken once here and holds a strong reference, so a
+        # concurrent reload/eviction can never swap it mid-batch
+        digest = batch[0].digest
+        if digest is None:
+            applier = self.applier
+        else:
+            applier = self._tenants.lookup_dispatch(digest) \
+                if self._tenants is not None else None
+            if applier is None:
+                # admitted-then-swept race (submit raced an admit's
+                # eviction before its track_submit): typed error, the
+                # router fails over to a replica holding the tenant
+                self._fail_batch(batch, TenantNotResidentError(
+                    f"policy {digest} was evicted before dispatch",
+                    digest,
+                    resident=(self._tenants.resident_digests()
+                              if self._tenants else ())))
+                return
         self._dispatch_attempts += 1
         if self.breaker.enabled and not self.breaker.allow():
             # open circuit: fail the whole batch fast — no device work
@@ -881,9 +1199,14 @@ class PolicyServer:
             if p.deadline is not None and done > p.deadline:
                 misses += 1
             p.event.set()
+            self._tenant_done(p)
         self._dispatches_ctr.inc()
         self._requests_ctr.inc(len(batch))
         self._images_ctr.inc(int(images.shape[0]))
+        if digest is not None:
+            t_reqs, t_imgs = self._tenant_counters(digest)
+            t_reqs.inc(len(batch))
+            t_imgs.inc(int(images.shape[0]))
         if misses:
             self._ctr["deadline_misses"].inc(misses)
         with self._lock:
@@ -907,6 +1230,11 @@ class PolicyServer:
             batch = self._collect(first)
             if batch:
                 self._dispatch(batch)
+            if self._tenants is not None:
+                # the dispatch boundary: retiring tenants whose queued
+                # work has drained release their appliers HERE, never
+                # while a dispatch is in flight
+                self._tenants.sweep()
         # drain on stop: in-flight clients must not hang forever
         leftovers = [self._carry] if self._carry is not None else []
         self._carry = None
@@ -919,6 +1247,7 @@ class PolicyServer:
             p.error = ServerStoppedError("server stopped")
             p.t_done = mono()
             p.event.set()
+            self._tenant_done(p)
 
     # ----------------------------------------------------------- stats
 
@@ -999,6 +1328,9 @@ class PolicyServer:
             "reloads": self.reloads,
             "draining": self._closed.is_set(),
         }
+        out["default_digest"] = self.default_digest
+        if self._tenants is not None:
+            out["tenancy"] = self._tenants.snapshot()
         if sizes:
             out["mean_batch"] = round(float(np.mean(sizes)), 2)
             out["mean_dispatch_ms"] = round(float(np.mean(walls)) * 1e3, 3)

@@ -1,0 +1,535 @@
+"""The serving front door: policy-digest-affinity routing over a
+replica fleet.
+
+"Millions of users" means many policies across many replicas behind ONE
+address, not one policy on one port (ROADMAP "Production serving
+plane").  This module is the control-tier router of that plane — a
+centralized steering component over single-purpose serving workers,
+the Podracer architecture shape (PAPERS.md, arXiv:2104.06272), kept
+deliberately host-only (no jax import): the router never touches a
+device, it decides WHERE device work lands.
+
+Three mechanisms:
+
+- **Replica discovery** (:func:`discover_replicas`): replicas announce
+  themselves by writing ``<tag>.json`` records into a shared
+  ``--port-dir`` (``serve_cli --port-dir``, the ``--port-file``
+  contract generalized), so ``launch/fleet.py --no-rank-args`` replica
+  fleets need no static port plan — a relaunched replica atomically
+  overwrites its record, a drained one removes it.  Static
+  ``host:port`` lists are supported for fixed topologies.
+
+- **Digest-affinity routing** (:func:`rendezvous_order`): requests
+  carrying ``X-FAA-Policy-Digest`` are routed by RENDEZVOUS (highest-
+  random-weight) hashing digest -> replica, so each policy's traffic
+  concentrates on the replica(s) already holding that tenant AOT-warm.
+  Rendezvous hashing is minimally disruptive by construction: a
+  replica joining or leaving moves ONLY the keys that hash to it —
+  every other digest keeps its primary, and its tenant stays warm.
+  Digest-less requests round-robin across the rotation.
+
+- **Health-aware rotation + bounded failover**: a poll loop probes
+  each replica's ``/readyz`` (the PR-8 readiness surface: draining or
+  breaker-open replicas answer 503 while ``/healthz`` stays 200);
+  ``eject_after`` consecutive failures remove a replica from rotation,
+  ``readmit_after`` consecutive successes re-admit it — hysteresis, so
+  a flapping backend does not oscillate per poll.  Each transition is
+  a typed ``rotation`` journal event.  Per request, the router tries
+  at most ``1 + failover_attempts`` candidates in rendezvous order; an
+  upstream 429/503 marks the replica BACKING OFF for its
+  ``Retry-After`` (new traffic routes around it until the window
+  passes) and fails over; when every candidate is exhausted the last
+  upstream answer (Retry-After included) passes through to the client.
+
+The ``FAA_FAULT`` verbs ``replica_down@request=N`` and
+``readyz_flap@period=P`` are consulted at the health-poll seam
+(``utils/faultinject.py``) so rotation ejection, failover and
+degraded-goodput behavior are all deterministically drillable without
+killing real processes.  ``tools/bench_router.py`` (``make
+bench-router``) measures routed-vs-direct cost and affinity hit rate;
+docs/SERVING.md documents the plane end to end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+from fast_autoaugment_tpu.core import telemetry
+from fast_autoaugment_tpu.core.telemetry import mono, wall
+from fast_autoaugment_tpu.utils.logging import get_logger
+
+__all__ = ["Replica", "Router", "rendezvous_order", "discover_replicas",
+           "parse_static_replicas"]
+
+logger = get_logger("faa_tpu.router")
+
+#: headers the router forwards verbatim to the chosen replica (the
+#: deadline-passthrough + tenancy contract); everything else is
+#: hop-local
+FORWARD_HEADERS = ("X-FAA-Deadline-Ms", "X-FAA-Policy-Digest",
+                   "Content-Type")
+
+
+def rendezvous_order(digest: str, replica_ids: list[str]) -> list[str]:
+    """Replica ids ranked by rendezvous (HRW) weight for `digest`.
+
+    ``sha256(digest | replica_id)`` scores each pair; the ranking is a
+    pure function of the (digest, id) pairs, so every router instance
+    agrees, and a join/leave reshuffles ONLY the keys scored highest on
+    the joined/left replica — warm tenants elsewhere stay put."""
+    scored = sorted(
+        replica_ids,
+        key=lambda rid: hashlib.sha256(
+            f"{digest}|{rid}".encode()).digest(),
+        reverse=True)
+    return scored
+
+
+def parse_static_replicas(spec: str) -> list[dict]:
+    """``host:port,host:port`` -> replica records (static topologies)."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad replica spec {part!r}: want host:port")
+        out.append({"tag": part, "host": host, "port": int(port)})
+    return out
+
+
+def discover_replicas(port_dir: str) -> list[dict]:
+    """Read every ``<tag>.json`` replica record under `port_dir`
+    (written by ``serve_cli --port-dir``).  Unreadable / torn records
+    are skipped — the writer is atomic (os.replace), so a skip means a
+    writer mid-crash, and the next scan settles it."""
+    records: list[dict] = []
+    try:
+        names = sorted(os.listdir(port_dir))
+    except OSError:
+        return records
+    for name in names:
+        if not name.endswith(".json") or name.startswith("."):
+            continue
+        path = os.path.join(port_dir, name)
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        try:
+            records.append({
+                "tag": str(rec.get("tag") or os.path.splitext(name)[0]),
+                "host": str(rec["host"]),
+                "port": int(rec["port"]),
+                "pid": int(rec.get("pid", 0)),
+            })
+        except (KeyError, TypeError, ValueError):
+            continue
+    return records
+
+
+class Replica:
+    """One upstream serving replica's rotation state.  All mutation
+    happens under the owning :class:`Router`'s lock."""
+
+    __slots__ = ("tag", "host", "port", "in_rotation", "consecutive_fail",
+                 "consecutive_ok", "backoff_until", "forced_down",
+                 "last_verdict", "last_reason", "joined_at")
+
+    def __init__(self, tag: str, host: str, port: int):
+        self.tag = tag
+        self.host = host
+        self.port = int(port)
+        # a discovered replica starts OUT of rotation and earns its way
+        # in through readyz successes — never route at an unproven port
+        self.in_rotation = False
+        self.consecutive_fail = 0
+        self.consecutive_ok = 0
+        self.backoff_until = 0.0   # mono() horizon from 429/503 answers
+        self.forced_down = False   # latched by replica_down faultinject
+        self.last_verdict: bool | None = None
+        self.last_reason = "unpolled"
+        self.joined_at = wall()
+
+    def snapshot(self) -> dict:
+        return {
+            "tag": self.tag,
+            "addr": f"{self.host}:{self.port}",
+            "in_rotation": self.in_rotation,
+            "consecutive_fail": self.consecutive_fail,
+            "consecutive_ok": self.consecutive_ok,
+            "backing_off": self.backoff_until > mono(),
+            "forced_down": self.forced_down,
+            "last_verdict": self.last_verdict,
+            "last_reason": self.last_reason,
+        }
+
+
+class Router:
+    """Digest-affinity front door over N serving replicas.
+
+    Handler threads call :meth:`forward`; one poll thread runs
+    :meth:`poll_loop`.  The replica table is mutated only under
+    ``_lock``; upstream network I/O always happens OUTSIDE it."""
+
+    def __init__(self, *, port_dir: str | None = None,
+                 static_replicas: list[dict] | None = None,
+                 poll_interval_s: float = 0.5,
+                 eject_after: int = 2, readmit_after: int = 1,
+                 readyz_timeout_s: float = 2.0,
+                 upstream_timeout_s: float = 60.0,
+                 failover_attempts: int = 2,
+                 name: str = "router"):
+        if not port_dir and not static_replicas:
+            raise ValueError("router needs --port-dir or a static "
+                             "replica list")
+        self.port_dir = port_dir
+        self.poll_interval_s = float(poll_interval_s)
+        self.eject_after = max(1, int(eject_after))
+        self.readmit_after = max(1, int(readmit_after))
+        self.readyz_timeout_s = float(readyz_timeout_s)
+        self.upstream_timeout_s = float(upstream_timeout_s)
+        self.failover_attempts = max(0, int(failover_attempts))
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        self._rr = 0                 # round-robin cursor (digest-less)
+        self._poll_round = 0
+        self._requests_routed = 0    # the replica_down fault coordinate
+        self._stop = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+        # static replicas are membership by CONFIGURATION: discovery
+        # reconciliation never drops them (no port-dir record exists)
+        self._static_tags = {rec["tag"] for rec in (static_replicas or [])}
+        for rec in (static_replicas or []):
+            self._replicas[rec["tag"]] = Replica(rec["tag"], rec["host"],
+                                                 rec["port"])
+        reg = telemetry.registry()
+        self._req_ctr = {o: reg.counter(
+            "faa_router_requests_total",
+            "requests through the router by outcome",
+            outcome=o, router=self.name)
+            for o in ("ok", "failover_ok", "upstream_reject",
+                      "upstream_error", "no_replica")}
+        self._affinity_ctr = {r: reg.counter(
+            "faa_router_affinity_total",
+            "requests landing on their rendezvous-primary replica "
+            "(hit) vs a failover/backoff alternate (miss)",
+            result=r, router=self.name) for r in ("hit", "miss")}
+        self._failover_ctr = reg.counter(
+            "faa_router_failovers_total",
+            "upstream attempts beyond the first", router=self.name)
+        self._rotation_gauge = reg.gauge(
+            "faa_router_replicas", "replicas currently in rotation",
+            state="in_rotation", router=self.name)
+        self._known_gauge = reg.gauge(
+            "faa_router_replicas", "replicas known to the router",
+            state="known", router=self.name)
+
+    # ----------------------------------------------------- discovery
+
+    def refresh_discovery(self) -> None:
+        """Reconcile the replica table with the port-dir records: new
+        records join (out of rotation until proven ready), removed
+        records leave (a drained replica deleted its file; a crashed
+        one is ejected by the poll instead)."""
+        if not self.port_dir:
+            return
+        recs = {r["tag"]: r for r in discover_replicas(self.port_dir)}
+        with self._lock:
+            for tag, rec in recs.items():
+                cur = self._replicas.get(tag)
+                if cur is None:
+                    self._replicas[tag] = Replica(tag, rec["host"],
+                                                  rec["port"])
+                    logger.info("router: discovered replica %s at %s:%d",
+                                tag, rec["host"], rec["port"])
+                elif (cur.host, cur.port) != (rec["host"], rec["port"]):
+                    # relaunched on a new port: reset and re-prove
+                    self._replicas[tag] = Replica(tag, rec["host"],
+                                                  rec["port"])
+                    logger.info("router: replica %s moved to %s:%d",
+                                tag, rec["host"], rec["port"])
+            gone = [t for t in self._replicas
+                    if t not in recs and t not in self._static_tags]
+            for tag in gone:
+                rep = self._replicas.pop(tag)
+                if rep.in_rotation:
+                    telemetry.emit("rotation", self.name, action="leave",
+                                   replica=tag, reason="record_removed")
+                logger.info("router: replica %s left (record removed)",
+                            tag)
+            self._update_gauges_locked()
+
+    def _update_gauges_locked(self) -> None:
+        self._known_gauge.set(len(self._replicas))
+        self._rotation_gauge.set(
+            sum(1 for r in self._replicas.values() if r.in_rotation))
+
+    # -------------------------------------------------- health polling
+
+    def _readyz_verdict(self, rep: Replica) -> tuple[bool, str]:
+        """One real readiness probe (no fault interference)."""
+        import http.client
+
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.readyz_timeout_s)
+            try:
+                conn.request("GET", "/readyz")
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    return True, "ok"
+                return False, f"readyz {resp.status}"
+            finally:
+                conn.close()
+        except OSError as e:
+            return False, f"unreachable: {type(e).__name__}"
+
+    def _fault_victim_locked(self) -> str | None:
+        """The deterministic fault target: first known tag in sorted
+        order (the FAA_FAULT replica_down/readyz_flap contract)."""
+        return min(self._replicas) if self._replicas else None
+
+    def _consult_faults_locked(self) -> tuple[str | None, bool]:
+        """The health-poll fault seam: returns (victim_tag,
+        victim_down_this_round).  ``replica_down`` latches the victim's
+        ``forced_down``; ``readyz_flap`` alternates the victim's
+        verdict every P poll rounds."""
+        from fast_autoaugment_tpu.utils.faultinject import active_plan
+
+        plan = active_plan()
+        if plan is None:
+            return None, False
+        victim = self._fault_victim_locked()
+        if victim is None:
+            return None, False
+        if plan.replica_down_now(self._requests_routed):
+            self._replicas[victim].forced_down = True
+            logger.warning("faultinject: replica %s declared DOWN "
+                           "(replica_down)", victim)
+        period = plan.readyz_flap_period()
+        flap_down = (period is not None
+                     and ((self._poll_round - 1) // period) % 2 == 1)
+        return victim, flap_down
+
+    def poll_once(self) -> None:
+        """One health-poll round over every known replica, applying
+        the eject/readmit hysteresis and journaling transitions."""
+        with self._lock:
+            self._poll_round += 1
+            victim, flap_down = self._consult_faults_locked()
+            targets = list(self._replicas.values())
+        transitions = []
+        for rep in targets:
+            if rep.forced_down or (flap_down and rep.tag == victim):
+                ok, reason = False, ("forced_down" if rep.forced_down
+                                     else "readyz_flap")
+            else:
+                ok, reason = self._readyz_verdict(rep)
+            with self._lock:
+                if rep.tag not in self._replicas:
+                    continue  # left the table mid-round
+                rep.last_verdict, rep.last_reason = ok, reason
+                if ok:
+                    rep.consecutive_ok += 1
+                    rep.consecutive_fail = 0
+                    if not rep.in_rotation \
+                            and rep.consecutive_ok >= self.readmit_after:
+                        rep.in_rotation = True
+                        transitions.append(("readmit", rep.tag, reason))
+                else:
+                    rep.consecutive_fail += 1
+                    rep.consecutive_ok = 0
+                    if rep.in_rotation \
+                            and rep.consecutive_fail >= self.eject_after:
+                        rep.in_rotation = False
+                        transitions.append(("eject", rep.tag, reason))
+                self._update_gauges_locked()
+        for action, tag, reason in transitions:
+            logger.warning("router: %s replica %s (%s)", action, tag,
+                           reason)
+            telemetry.emit("rotation", self.name, action=action,
+                           replica=tag, reason=reason,
+                           poll_round=self._poll_round)
+
+    def poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.refresh_discovery()
+            self.poll_once()
+
+    def start(self) -> "Router":
+        """Arm the poll thread after one synchronous discovery+poll
+        round (the router answers with a populated table from its
+        first request)."""
+        self.refresh_discovery()
+        self.poll_once()
+        if self._poll_thread is None or not self._poll_thread.is_alive():
+            self._stop.clear()
+            self._poll_thread = threading.Thread(
+                target=self.poll_loop, daemon=True, name="router-poll")
+            self._poll_thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            # bounded join (lint R6): a wedged probe must not hang
+            # shutdown — the poller is a daemon either way
+            self._poll_thread.join(timeout=timeout)
+
+    # --------------------------------------------------------- routing
+
+    def candidates(self, digest: str | None) -> tuple[list[Replica], str | None]:
+        """``(candidate_list, primary_tag)``: in-rotation replicas in
+        rendezvous order for `digest` (round-robin without one),
+        non-backing-off first, truncated to 1 + failover_attempts.
+        `primary_tag` is the digest's rendezvous-FIRST in-rotation
+        replica BEFORE the backoff reordering — the affinity metric
+        counts landings against it, so routing around a cooling
+        primary reads as a miss, which it is.  Backing-off replicas
+        stay ELIGIBLE as a last resort — when the whole rotation is
+        cooling down, the least-recently-rejected answer's Retry-After
+        passes through to the client."""
+        now = mono()
+        with self._lock:
+            live = [r for r in self._replicas.values() if r.in_rotation]
+            if not live:
+                return [], None
+            if digest:
+                by_tag = {r.tag: r for r in live}
+                ordered = [by_tag[t] for t in rendezvous_order(
+                    digest, sorted(by_tag))]
+            else:
+                live.sort(key=lambda r: r.tag)
+                self._rr = (self._rr + 1) % len(live)
+                ordered = live[self._rr:] + live[:self._rr]
+            primary_tag = ordered[0].tag
+            ready = [r for r in ordered if r.backoff_until <= now]
+            cooling = [r for r in ordered if r.backoff_until > now]
+            return (ready + cooling)[:1 + self.failover_attempts], \
+                primary_tag
+
+    def _upstream(self, rep: Replica, method: str, path: str,
+                  body: bytes | None, headers: dict) -> tuple:
+        """One upstream attempt; returns (status, resp_headers, body)
+        or raises OSError on a transport failure."""
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self.upstream_timeout_s)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    def forward(self, method: str, path: str, body: bytes | None,
+                headers: dict, digest: str | None) -> tuple:
+        """Route one request: rendezvous candidates, bounded failover
+        on 429/503/transport errors honoring ``Retry-After``.  Returns
+        ``(status, headers, body, routed_tag)``."""
+        with self._lock:
+            self._requests_routed += 1
+        cands, primary_tag = self.candidates(digest)
+        if not cands:
+            self._req_ctr["no_replica"].inc()
+            return (503, {"Retry-After": "1"},
+                    json.dumps({"error": "no replica in rotation",
+                                "type": "no_replica"}).encode(), None)
+        last = None
+        for i, rep in enumerate(cands):
+            if i > 0:
+                self._failover_ctr.inc()
+            try:
+                status, rheaders, data = self._upstream(
+                    rep, method, path, body, headers)
+            except OSError as e:
+                logger.warning("router: upstream %s failed: %s",
+                               rep.tag, e)
+                last = (502, {}, json.dumps(
+                    {"error": f"upstream {rep.tag} unreachable: "
+                              f"{type(e).__name__}",
+                     "type": "upstream_unreachable"}).encode(), rep.tag)
+                continue
+            if status in (429, 503):
+                # honor Retry-After: route new traffic around this
+                # replica for the window it asked for, fail THIS
+                # request over to the next candidate
+                retry_after = _retry_after_s(rheaders)
+                with self._lock:
+                    if rep.tag in self._replicas:
+                        rep.backoff_until = mono() + retry_after
+                last = (status, rheaders, data, rep.tag)
+                continue
+            self._count_routed(rep.tag, primary_tag, i)
+            return status, rheaders, data, rep.tag
+        # every candidate exhausted: the last upstream answer (with its
+        # Retry-After) passes through; transport-only failures read 502
+        status = last[0]
+        self._req_ctr["upstream_reject" if status in (429, 503)
+                      else "upstream_error"].inc()
+        self._affinity_ctr["miss"].inc()
+        return last
+
+    def _count_routed(self, tag: str, primary_tag: str, attempt: int) -> None:
+        self._req_ctr["ok" if attempt == 0 else "failover_ok"].inc()
+        self._affinity_ctr["hit" if tag == primary_tag else "miss"].inc()
+        telemetry.registry().counter(
+            "faa_router_upstream_requests_total",
+            "requests served per upstream replica",
+            replica=tag, router=self.name).inc()
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            reps = {t: r.snapshot() for t, r in self._replicas.items()}
+            routed = self._requests_routed
+            poll_round = self._poll_round
+        hits = int(self._affinity_ctr["hit"].value)
+        misses = int(self._affinity_ctr["miss"].value)
+        total = hits + misses
+        return {
+            "router": self.name,
+            "port_dir": self.port_dir,
+            "replicas": reps,
+            "in_rotation": sorted(t for t, r in reps.items()
+                                  if r["in_rotation"]),
+            "requests_routed": routed,
+            "poll_round": poll_round,
+            "poll_interval_s": self.poll_interval_s,
+            "eject_after": self.eject_after,
+            "readmit_after": self.readmit_after,
+            "failover_attempts": self.failover_attempts,
+            "failovers": int(self._failover_ctr.value),
+            "affinity": {
+                "hits": hits, "misses": misses,
+                "hit_rate": round(hits / total, 4) if total else None,
+            },
+            "outcomes": {o: int(c.value)
+                         for o, c in self._req_ctr.items()},
+        }
+
+
+def _retry_after_s(headers: dict) -> float:
+    """Parse an upstream ``Retry-After`` (integral seconds; 0.5s floor
+    so a malformed/absent header still backs the replica off one
+    beat)."""
+    for k, v in headers.items():
+        if k.lower() == "retry-after":
+            try:
+                return max(0.5, float(v))
+            except (TypeError, ValueError):
+                break
+    return 0.5

@@ -26,6 +26,13 @@ a restarted server deserializes them in seconds), and serves:
   with, re-read).  The new policy AOT-warms off to the side and swaps
   in atomically — zero dropped requests, no half-policy batch.  SIGHUP
   triggers the same reload.
+- ``POST /tenants/warm`` — multi-policy tenancy preload (requires
+  ``--tenant-capacity``): body ``{"policy": PATH}`` AOT-warms the
+  policy OFF TO THE SIDE and admits it as a resident tenant.  Requests
+  then select it via the ``X-FAA-Policy-Digest`` header; a cold digest
+  answers a structured 503 (``tenant_cold``) and — with a
+  ``--policy-dir`` recipe — kicks a background warm
+  (docs/SERVING.md "Multi-policy tenancy").
 - ``GET /stats`` — serving accounting (admission/shed/breaker/reload
   counters included) + the ``compile_cache`` stamp.
 - ``GET /healthz`` — LIVENESS: 200 while the process runs.
@@ -76,6 +83,10 @@ DEFAULT_MAX_BODY_MB = 64
 
 DEADLINE_HEADER = "X-FAA-Deadline-Ms"
 
+#: selects the TENANT policy a request is served by (multi-policy
+#: tenancy, docs/SERVING.md); absent = the replica's default policy
+DIGEST_HEADER = "X-FAA-Policy-Digest"
+
 
 def build_policy_tensor(spec: str) -> np.ndarray:
     """``--policy`` -> [num_sub, num_op, 3] tensor.
@@ -115,7 +126,8 @@ class ServeState:
     handlers and the supervision threads share: the live server, the
     reload recipe, the shutdown path and the process exit code."""
 
-    def __init__(self, server, policy_spec: str, build_applier=None):
+    def __init__(self, server, policy_spec: str, build_applier=None,
+                 policy_dir: str | None = None):
         self.server = server
         self.policy_spec = policy_spec
         self.build_applier = build_applier  # policy tensor -> applier
@@ -124,6 +136,12 @@ class ServeState:
         self.stop_event = threading.Event()
         self.reload_lock = threading.Lock()
         self.started_at = wall()
+        # tenancy: cold-policy recipes (--policy-dir) + the
+        # single-flight background-warm bookkeeping
+        self.policy_dir = policy_dir
+        self.tenant_warm_lock = threading.Lock()
+        self.warming: set[str] = set()
+        self._digest_cache: dict[str, tuple[float, str]] = {}
 
     # ------------------------------------------------------- readiness
 
@@ -161,6 +179,83 @@ class ServeState:
             return info
         finally:
             self.reload_lock.release()
+
+    # --------------------------------------------------------- tenancy
+
+    def tenant_recipe(self, digest: str) -> str | None:
+        """Resolve a cold digest to a policy file under
+        ``--policy-dir``: ``<digest>.json`` directly, else any
+        ``*.json`` whose tensor digest matches (mtime-cached scan)."""
+        if not self.policy_dir:
+            return None
+        direct = os.path.join(self.policy_dir, f"{digest}.json")
+        if os.path.exists(direct):
+            return direct
+        from fast_autoaugment_tpu.serve.policy_server import policy_digest
+
+        try:
+            names = sorted(os.listdir(self.policy_dir))
+        except OSError:
+            return None
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.policy_dir, name)
+            try:
+                mtime = os.path.getmtime(path)
+                cached = self._digest_cache.get(path)
+                if cached is None or cached[0] != mtime:
+                    cached = (mtime,
+                              policy_digest(build_policy_tensor(path)))
+                    self._digest_cache[path] = cached
+                if cached[1] == digest:
+                    return path
+            except (OSError, ValueError) as e:
+                logger.warning("policy-dir scan skipped %s: %s", path, e)
+        return None
+
+    def warm_tenant(self, spec: str) -> dict:
+        """Build an applier for `spec` OFF TO THE SIDE (AOT-warming
+        every padded shape while warm tenants keep dispatching) and
+        admit it into the tenancy LRU."""
+        if self.build_applier is None:
+            raise RuntimeError("tenancy warm not configured")
+        t0 = mono()
+        policy = build_policy_tensor(spec)
+        applier = self.build_applier(policy)
+        info = self.server.warm_tenant(applier)
+        info.update(policy=spec, warm_sec=round(mono() - t0, 3))
+        logger.info("tenant warm complete: %s", info)
+        return info
+
+    def kick_background_warm(self, digest: str) -> bool:
+        """Single-flight background AOT warm for a cold digest with a
+        known recipe.  True when a warm is already running or was just
+        kicked — the 503 answer then carries ``warming: true`` so the
+        client (or router) retries once the tenant is resident."""
+        if not getattr(self.server, "tenancy_enabled", False):
+            return False
+        with self.tenant_warm_lock:
+            if digest in self.warming:
+                return True
+            spec = self.tenant_recipe(digest)
+            if spec is None:
+                return False
+            self.warming.add(digest)
+
+        def _go():
+            try:
+                self.warm_tenant(spec)
+            except (ValueError, OSError, RuntimeError) as e:
+                logger.error("background tenant warm %s failed: %s",
+                             digest, e)
+            finally:
+                with self.tenant_warm_lock:
+                    self.warming.discard(digest)
+
+        threading.Thread(target=_go, daemon=True,
+                         name=f"tenant-warm-{digest}").start()
+        return True
 
     # -------------------------------------------------------- shutdown
 
@@ -201,6 +296,7 @@ def make_handler(server, applier, state: ServeState | None = None,
         ServeError,
         ServerOverloadedError,
         ServerStoppedError,
+        TenantNotResidentError,
     )
 
     inflight = (threading.BoundedSemaphore(max_inflight)
@@ -330,8 +426,10 @@ def make_handler(server, applier, state: ServeState | None = None,
                     keys = None
                     if "seeds" in payload.files:
                         keys = _seed_keys(payload["seeds"])
+                    digest = self.headers.get(DIGEST_HEADER)
                     pending = server.submit(images, keys,
-                                            deadline_ms=deadline_ms)
+                                            deadline_ms=deadline_ms,
+                                            digest=digest)
                     out = server.result(pending)
                 except TimeoutError as e:
                     # NOTE: before the OSError catch — TimeoutError IS
@@ -341,6 +439,21 @@ def make_handler(server, applier, state: ServeState | None = None,
                 except (KeyError, ValueError, OSError) as e:
                     self._send_error_json(400, "bad_request",
                                           f"{type(e).__name__}: {e}")
+                    return
+                except TenantNotResidentError as e:
+                    # cold tenant: structured 503 + (when a recipe
+                    # exists) a BACKGROUND warm — the request path
+                    # never blocks on an AOT compile; the router fails
+                    # over to a replica already holding the tenant
+                    warming = (state.kick_background_warm(e.digest)
+                               if state is not None and e.digest
+                               else False)
+                    headers = {"Retry-After": "1"} if warming else {}
+                    self._send_json(503, {
+                        "error": str(e), "type": "tenant_cold",
+                        "digest": e.digest,
+                        "resident": list(e.resident),
+                        "warming": warming}, headers)
                     return
                 except ServerOverloadedError as e:
                     self._send_error_json(429, "overloaded", str(e),
@@ -391,11 +504,48 @@ def make_handler(server, applier, state: ServeState | None = None,
             except BlockingIOError as e:
                 self._send_error_json(409, "reload_in_progress", str(e))
                 return
-            except (ValueError, OSError, RuntimeError) as e:
+            except (KeyError, ValueError, OSError, RuntimeError) as e:
+                # KeyError: an unknown policy-archive name — same
+                # client-error class as a bad path
                 self._send_error_json(400, "reload_failed",
                                       f"{type(e).__name__}: {e}")
                 return
             self._send_json(200, {"reloaded": True, **info})
+
+        def _do_tenant_warm(self) -> None:
+            """POST /tenants/warm {"policy": PATH}: AOT-warm a policy
+            off to the side and admit it as a resident tenant (the
+            operator/router preload path)."""
+            if state is None:
+                self._send_error_json(503, "not_configured",
+                                      "tenancy warm not configured")
+                return
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            spec = None
+            if length > 0:
+                if length > max_body_bytes:
+                    self._send_error_json(413, "body_too_large",
+                                          "warm body too large")
+                    return
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    spec = req.get("policy")
+                except (ValueError, AttributeError):
+                    spec = None
+            if not spec:
+                self._send_error_json(400, "bad_request",
+                                      "warm body must be JSON "
+                                      '{"policy": PATH}')
+                return
+            try:
+                info = state.warm_tenant(spec)
+            except (KeyError, ValueError, OSError, RuntimeError) as e:
+                # KeyError: an unknown policy-archive name from
+                # build_policy_tensor — a client error, not a crash
+                self._send_error_json(400, "warm_failed",
+                                      f"{type(e).__name__}: {e}")
+                return
+            self._send_json(200, {"warmed": True, **info})
 
         def do_POST(self):
             try:
@@ -403,6 +553,8 @@ def make_handler(server, applier, state: ServeState | None = None,
                     self._do_augment()
                 elif self.path == "/reload":
                     self._do_reload()
+                elif self.path == "/tenants/warm":
+                    self._do_tenant_warm()
                 else:
                     self._send_error_json(404, "unknown_path",
                                           f"unknown path {self.path}")
@@ -565,6 +717,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="write the BOUND port (supports --port 0) to PATH "
                         "— how supervised tests find the replica")
+    p.add_argument("--port-dir", default=None, metavar="DIR",
+                   help="atomically write DIR/<tag>.json ({tag, host, "
+                        "port, pid}) on bind and remove it on exit — "
+                        "the shared replica-discovery dir the serving "
+                        "ROUTER (serve/router.py) and faa_status census "
+                        "watch; fleet --no-rank-args replicas need no "
+                        "static port plan (docs/SERVING.md)")
+    # ---------------- multi-policy tenancy (defaults off = the
+    # single-policy PR-11 byte-identical stream) ----------------------
+    p.add_argument("--tenant-capacity", type=int, default=0,
+                   help="resident-tenant LRU capacity for multi-policy "
+                        "tenancy: requests select a policy via the "
+                        f"{DIGEST_HEADER} header, cold policies AOT-warm "
+                        "off to the side, the LRU tenant retires at a "
+                        "dispatch boundary.  0 = single-policy serving "
+                        "(historical)")
+    p.add_argument("--policy-dir", default=None, metavar="DIR",
+                   help="cold-tenant recipes: a requested-but-cold "
+                        "digest resolving to DIR/<digest>.json (or any "
+                        "*.json with a matching tensor digest) kicks a "
+                        "BACKGROUND warm; the 503 answer carries "
+                        "warming=true so clients/routers retry once "
+                        "resident")
     return p
 
 
@@ -602,8 +777,10 @@ def main(argv=None):
         lifo_depth=args.lifo_depth, lifo_age_ms=args.lifo_age_ms,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown,
-        dispatch_timeout_s=args.dispatch_timeout).start()
-    state = ServeState(server, args.policy, build_applier)
+        dispatch_timeout_s=args.dispatch_timeout,
+        tenant_capacity=args.tenant_capacity).start()
+    state = ServeState(server, args.policy, build_applier,
+                       policy_dir=args.policy_dir)
     cc = compile_cache_stats()
     logger.info(
         "serving %d sub-policies (dispatch=%s) at http://%s:%d — AOT "
@@ -622,8 +799,22 @@ def main(argv=None):
     if args.port_file:
         with open(args.port_file, "w") as fh:
             fh.write(str(bound_port))
+    replica_tag = args.host_tag or f"host{os.environ.get('FAA_HOST_ID', '0')}"
+    port_dir_path = None
+    if args.port_dir:
+        # atomic replica-discovery record: the router's census scans
+        # these; a relaunch (same tag) atomically overwrites its
+        # predecessor's record
+        os.makedirs(args.port_dir, exist_ok=True)
+        port_dir_path = os.path.join(args.port_dir, f"{replica_tag}.json")
+        tmp = f"{port_dir_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"tag": replica_tag, "host": args.host,
+                       "port": bound_port, "pid": os.getpid(),
+                       "started_at": wall()}, fh)
+        os.replace(tmp, port_dir_path)
     logger.info("listening on http://%s:%d (readyz/healthz/stats/"
-                "augment/reload)", args.host, bound_port)
+                "augment/reload/tenants)", args.host, bound_port)
 
     def shutdown(signum, frame):
         # graceful drain: stop admitting, finish in-flight, exit 0 —
@@ -652,9 +843,8 @@ def main(argv=None):
         threading.Thread(target=_breaker_exit_loop, args=(state,),
                          daemon=True, name="breaker-exit").start()
     if args.heartbeat_dir:
-        tag = args.host_tag or f"host{os.environ.get('FAA_HOST_ID', '0')}"
         threading.Thread(target=_beat_loop,
-                         args=(state, args.heartbeat_dir, tag, 1.0),
+                         args=(state, args.heartbeat_dir, replica_tag, 1.0),
                          daemon=True, name="host-beat").start()
     if args.serve_seconds > 0:
         timer = threading.Timer(
@@ -670,6 +860,13 @@ def main(argv=None):
         state.stop_event.set()
         httpd.server_close()
         server.stop()
+        if port_dir_path is not None:
+            try:
+                # leave no stale discovery record: the router census
+                # drops this replica instead of health-polling a ghost
+                os.remove(port_dir_path)
+            except OSError as e:
+                logger.warning("could not remove port-dir record: %s", e)
     return state.exit_code
 
 

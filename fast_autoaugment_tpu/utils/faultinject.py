@@ -59,6 +59,20 @@ Kinds and their trigger coordinates:
     wall EMA extra (F seconds before any observation) — a serving
     straggler; with a ``dispatch_timeout_s`` configured the overtime
     counts as a breaker failure even though the results are delivered.
+``replica_down@request=N``
+    Consulted at the ROUTER's health-poll seam with the router's
+    1-based routed-request counter: once >= N requests have been
+    routed, the poll declares ONE deterministic replica (the first in
+    sorted rotation order) dead — health checks for it fail from then
+    on (latched), driving the eject-from-rotation / degraded-goodput
+    failover path without killing a real process
+    (``serve/router.py``).
+``readyz_flap@period=P``
+    The router's health poll flips the named-deterministic FIRST
+    replica's readiness verdict every P poll rounds (down for rounds
+    where ``((round-1)//P) % 2 == 1``) — the flapping-backend case the
+    rotation hysteresis must ride through (eject on repeated failure,
+    re-enter on recovery, never oscillate per-poll).
 
 Each step/save/trial-pinned spec fires exactly ONCE per process (the
 counter-based kinds are consumed when hit); ``io_error`` fires per its
@@ -102,6 +116,8 @@ _KINDS = {
     "stale_lease": ("unit",),
     "serve_error": ("dispatch", "attempt"),
     "serve_slow": ("dispatch", "factor", "attempt"),
+    "replica_down": ("request", "attempt"),
+    "readyz_flap": ("period", "attempt"),
 }
 
 # keys that are optional for their kind (everything else is required)
@@ -267,6 +283,31 @@ class FaultPlan:
         f = self._take("serve_slow", "dispatch", dispatch_n)
         if f is not None:
             return ("slow", float(f["factor"]))
+        return None
+
+    def replica_down_now(self, request_n: int) -> bool:
+        """Consulted at the router's health-poll seam with the 1-based
+        routed-request counter.  Fires ONCE when the counter reaches
+        the spec's coordinate; the router latches the verdict itself
+        (a declared-dead replica stays dead)."""
+        return self._take("replica_down", "request", request_n,
+                          at_least=True) is not None
+
+    def readyz_flap_period(self) -> int | None:
+        """The active readyz_flap period, or None.  LATCHES like
+        stale_lease: the flap governs every later poll round (the
+        router applies ``((round-1)//P) % 2`` to its own counter)."""
+        for f in self.faults:
+            if f["kind"] != "readyz_flap":
+                continue
+            if "attempt" in f and current_attempt() != f["attempt"]:
+                continue
+            if not f["fired"]:
+                f["fired"] = True
+                logger.warning(
+                    "faultinject: readyz verdict flapping every %d "
+                    "health-poll round(s) from now on", f["period"])
+            return int(f["period"])
         return None
 
     def lease_stale(self, unit: str) -> bool:

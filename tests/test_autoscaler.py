@@ -1,0 +1,437 @@
+"""The telemetry-driven autoscaler (serve/autoscaler.py): the pure
+watermark/hysteresis/cooldown state machine on synthetic metrics, the
+Prometheus scrape path, the journaled control loop, and the local
+replica-fleet actuator — fast and host-only."""
+
+from __future__ import annotations
+
+import glob
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from fast_autoaugment_tpu.serve.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+    LocalReplicaFleet,
+    ReplicaScraper,
+    parse_prometheus_text,
+)
+
+_NAME_SEQ = itertools.count()
+
+OVER = {"queue_depth": 50.0, "shed_rate": 10.0, "breaker_open": False}
+IDLE = {"queue_depth": 0.0, "shed_rate": 0.0, "breaker_open": False}
+MID = {"queue_depth": 4.0, "shed_rate": 0.2, "breaker_open": False}
+
+
+def _policy(**kw) -> AutoscalerPolicy:
+    kw.setdefault("high_queue", 8.0)
+    kw.setdefault("low_queue", 1.0)
+    kw.setdefault("high_shed_rate", 1.0)
+    kw.setdefault("low_shed_rate", 0.0)
+    return AutoscalerPolicy(**kw)
+
+
+# ---------------------------------------------- the pure state machine
+
+
+def test_policy_watermark_classification():
+    p = _policy(up_polls=1, down_polls=1, cooldown_s=0.0)
+    assert p.decide(OVER, 1, 0.0)[0] == "up"
+    p2 = _policy(up_polls=1, down_polls=1, cooldown_s=0.0)
+    assert p2.decide(IDLE, 2, 0.0)[0] == "down"
+    # the dead band between watermarks: nothing happens, ever
+    p3 = _policy(up_polls=1, down_polls=1, cooldown_s=0.0)
+    for i in range(10):
+        assert p3.decide(MID, 2, float(i)) == (None, "nominal")
+
+
+def test_policy_breaker_open_is_overload():
+    p = _policy(up_polls=1, cooldown_s=0.0)
+    sig = {"queue_depth": 0.0, "shed_rate": 0.0, "breaker_open": True}
+    action, reason = p.decide(sig, 1, 0.0)
+    assert action == "up" and "breaker_open=True" in reason
+
+
+def test_policy_hysteresis_needs_consecutive_breaches():
+    p = _policy(up_polls=3, cooldown_s=0.0)
+    assert p.decide(OVER, 1, 0.0)[0] is None
+    assert p.decide(OVER, 1, 1.0)[0] is None
+    # a nominal poll RESETS the streak — one blip never scales
+    assert p.decide(MID, 1, 2.0)[0] is None
+    assert p.decide(OVER, 1, 3.0)[0] is None
+    assert p.decide(OVER, 1, 4.0)[0] is None
+    assert p.decide(OVER, 1, 5.0)[0] == "up"
+
+
+def test_policy_cooldown_blocks_consecutive_actions():
+    p = _policy(up_polls=1, cooldown_s=10.0, max_replicas=8)
+    assert p.decide(OVER, 1, 100.0)[0] == "up"
+    # still overloaded, but cooling down: hold
+    assert p.decide(OVER, 2, 101.0)[0] is None
+    assert p.decide(OVER, 2, 109.9)[0] is None
+    assert p.decide(OVER, 2, 110.1)[0] == "up"
+
+
+def test_policy_cooldown_applies_across_directions():
+    p = _policy(up_polls=1, down_polls=1, cooldown_s=10.0)
+    assert p.decide(OVER, 1, 0.0)[0] == "up"
+    # load vanished instantly: the cooldown still holds the shrink
+    assert p.decide(IDLE, 2, 1.0)[0] is None
+    assert p.decide(IDLE, 2, 11.0)[0] == "down"
+
+
+def test_policy_respects_fleet_bounds():
+    p = _policy(up_polls=1, down_polls=1, cooldown_s=0.0,
+                min_replicas=1, max_replicas=2)
+    assert p.decide(OVER, 2, 0.0)[0] is None  # at max: hold
+    assert p.decide(IDLE, 1, 1.0)[0] is None  # at min: hold
+    assert p.decide(OVER, 1, 2.0)[0] == "up"
+    assert p.decide(IDLE, 2, 3.0)[0] == "down"
+
+
+def test_policy_invalid_configs_raise():
+    with pytest.raises(ValueError):
+        _policy(high_queue=1.0, low_queue=2.0)
+    with pytest.raises(ValueError):
+        _policy(high_shed_rate=0.0, low_shed_rate=1.0)
+    with pytest.raises(ValueError):
+        _policy(min_replicas=5, max_replicas=2)
+
+
+def test_policy_full_drill_up_then_cooldown_then_down():
+    """The acceptance shape on synthetic metrics: overload -> scale_up
+    after up_polls, cooldown holds, load drains -> scale_down after
+    down_polls once the cooldown passes."""
+    p = _policy(up_polls=2, down_polls=3, cooldown_s=5.0,
+                min_replicas=1, max_replicas=3)
+    t = 0.0
+    actions = []
+    timeline = [OVER] * 4 + [IDLE] * 12
+    n = 1
+    for sig in timeline:
+        a, _r = p.decide(sig, n, t)
+        if a == "up":
+            n += 1
+        elif a == "down":
+            n -= 1
+        actions.append(a)
+        t += 1.0
+    assert actions.count("up") == 1 and actions.count("down") == 1
+    assert actions.index("up") == 1          # after 2 overloaded polls
+    down_at = actions.index("down")
+    assert down_at >= 6                      # cooldown + 3 idle polls
+    assert n == 1                            # back at the floor
+
+
+# ------------------------------------------------------- scrape path
+
+
+def test_parse_prometheus_roundtrip():
+    from fast_autoaugment_tpu.core import telemetry
+
+    reg = telemetry.MetricsRegistry()
+    reg.gauge("faa_serve_queue_depth", "q", server="3").set(7.0)
+    reg.counter("faa_serve_robustness_total", "r",
+                counter="shed_overload", server="3").inc(11)
+    reg.gauge("faa_breaker_open", "b", breaker="serve3").set(1.0)
+    reg.histogram("faa_dispatch_seconds", "h", label="x").observe(0.1)
+    fams = parse_prometheus_text(reg.prometheus_text())
+    assert fams["faa_serve_queue_depth"] == [({"server": "3"}, 7.0)]
+    labels, v = fams["faa_serve_robustness_total"][0]
+    assert labels == {"counter": "shed_overload", "server": "3"}
+    assert v == 11.0
+    assert fams["faa_breaker_open"][0][1] == 1.0
+    assert "faa_dispatch_seconds_bucket" in fams  # histograms expand
+
+
+class StubMetricsReplica:
+    """A /metrics endpoint whose queue/shed/breaker numbers the test
+    steers directly."""
+
+    def __init__(self):
+        self.queue_depth = 0.0
+        self.shed_total = 0.0
+        self.breaker_open = 0.0
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                with stub._lock:
+                    body = (
+                        "# TYPE faa_serve_queue_depth gauge\n"
+                        f'faa_serve_queue_depth{{server="0"}} '
+                        f"{stub.queue_depth:g}\n"
+                        "# TYPE faa_serve_robustness_total counter\n"
+                        f'faa_serve_robustness_total{{counter='
+                        f'"shed_overload",server="0"}} '
+                        f"{stub.shed_total:g}\n"
+                        "# TYPE faa_breaker_open gauge\n"
+                        f'faa_breaker_open{{breaker="serve0"}} '
+                        f"{stub.breaker_open:g}\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.port = self.httpd.server_address[1]
+
+    def set(self, queue=None, shed=None, breaker=None):
+        with self._lock:
+            if queue is not None:
+                self.queue_depth = float(queue)
+            if shed is not None:
+                self.shed_total = float(shed)
+            if breaker is not None:
+                self.breaker_open = float(breaker)
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _port_dir_with(tmp_path, stubs) -> str:
+    d = tmp_path / "replicas"
+    d.mkdir(exist_ok=True)
+    for i, s in enumerate(stubs):
+        (d / f"replica{i}.json").write_text(json.dumps(
+            {"tag": f"replica{i}", "host": "127.0.0.1", "port": s.port}))
+    return str(d)
+
+
+def test_scraper_aggregates_and_derives_rates(tmp_path):
+    stubs = [StubMetricsReplica(), StubMetricsReplica()]
+    try:
+        port_dir = _port_dir_with(tmp_path, stubs)
+        sc = ReplicaScraper(port_dir)
+        stubs[0].set(queue=3, shed=10)
+        stubs[1].set(queue=9, shed=0, breaker=1)
+        sig = sc.scrape()
+        assert sig["reachable"] == 2
+        assert sig["queue_depth"] == 9.0     # max across replicas
+        assert sig["shed_rate"] == 0.0       # first scrape: no baseline
+        assert sig["breaker_open"] is True
+        time.sleep(0.05)
+        stubs[0].set(shed=20)  # ~10 sheds over the interval
+        sig = sc.scrape()
+        assert sig["shed_rate"] > 0.0
+        assert sig["replicas"]["replica1"]["shed_rate"] == 0.0
+    finally:
+        for s in stubs:
+            s.close()
+
+
+def test_scraper_unreachable_replica_counts_out(tmp_path):
+    stub = StubMetricsReplica()
+    port_dir = _port_dir_with(tmp_path, [stub])
+    stub.close()
+    sig = ReplicaScraper(port_dir).scrape()
+    assert sig["reachable"] == 0
+    assert sig["replicas"]["replica0"]["reachable"] is False
+    assert sig["queue_depth"] == 0.0 and sig["breaker_open"] is False
+
+
+# --------------------------------------------- the journaled loop
+
+
+def test_autoscaler_journals_up_then_down(tmp_path):
+    """The acceptance drill's control half on a steered signal: an
+    overload drives a journaled scale_up (metric evidence inline), the
+    cooldown holds, the drained fleet drives a journaled scale_down —
+    and the registry counters agree."""
+    from fast_autoaugment_tpu.core import telemetry as T
+
+    T.enable_telemetry(str(tmp_path / "tel"), tb_bridge=False)
+    try:
+        signal_box = {"sig": dict(OVER)}
+        fleet = {"n": 1}
+
+        def scrape():
+            return dict(signal_box["sig"])
+
+        def up():
+            fleet["n"] += 1
+            return f"replica{fleet['n'] - 1}"
+
+        def down():
+            fleet["n"] -= 1
+            return f"replica{fleet['n']}"
+
+        policy = _policy(up_polls=2, down_polls=2, cooldown_s=0.2,
+                         min_replicas=1, max_replicas=3)
+        scaler = Autoscaler(scrape, up, down, lambda: fleet["n"], policy,
+                            name=f"as{next(_NAME_SEQ)}")
+        assert scaler.step() is None   # hysteresis: first breach holds
+        assert scaler.step() == "up"
+        assert fleet["n"] == 2
+        assert scaler.step() is None   # cooldown
+        signal_box["sig"] = dict(IDLE)
+        deadline = time.monotonic() + 10.0
+        action = None
+        while time.monotonic() < deadline:
+            action = scaler.step()
+            if action == "down":
+                break
+            time.sleep(0.05)
+        assert action == "down" and fleet["n"] == 1
+        st = scaler.stats()
+        assert st["scale_ups"] == 1 and st["scale_downs"] == 1
+        T.journal_flush()
+        recs = []
+        for path in glob.glob(str(tmp_path / "tel" / "journal-*.jsonl")):
+            with open(path) as fh:
+                recs += [json.loads(ln) for ln in fh if ln.strip()]
+        ups = [x for x in recs if x["type"] == "scale_up"]
+        downs = [x for x in recs if x["type"] == "scale_down"]
+        assert len(ups) == 1 and len(downs) == 1
+        # the metric evidence rides INLINE in the decision event
+        assert ups[0]["queue_depth"] == OVER["queue_depth"]
+        assert ups[0]["shed_rate"] == OVER["shed_rate"]
+        assert ups[0]["replicas_before"] == 1
+        assert ups[0]["replicas_after"] == 2
+        assert ups[0]["replica"] == "replica1"
+        assert downs[0]["replicas_after"] == 1
+    finally:
+        T._disable_for_tests()
+
+
+def test_autoscaler_loop_thread_lifecycle():
+    policy = _policy(up_polls=1, cooldown_s=0.0, max_replicas=2)
+    fleet = {"n": 1}
+    scaler = Autoscaler(lambda: dict(OVER),
+                        lambda: fleet.__setitem__("n", fleet["n"] + 1),
+                        lambda: fleet.__setitem__("n", fleet["n"] - 1),
+                        lambda: fleet["n"], policy,
+                        poll_interval_s=0.02,
+                        name=f"as{next(_NAME_SEQ)}")
+    scaler.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while fleet["n"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fleet["n"] == 2  # scaled up, then held at max
+    finally:
+        scaler.stop()
+
+
+# ------------------------------------------------- the fleet actuator
+
+
+_FAKE_REPLICA = (
+    "import signal, sys, time\n"
+    "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+    "time.sleep(120)\n")
+
+
+def test_local_replica_fleet_spawn_and_drain(tmp_path):
+    """scale_up launches a tagged replica process with the port-dir
+    args appended; scale_down SIGTERMs the NEWEST (LIFO) and reaps
+    it."""
+    fleet = LocalReplicaFleet(
+        [sys.executable, "-c", _FAKE_REPLICA], str(tmp_path / "replicas"))
+    try:
+        assert fleet.count() == 0
+        t0 = fleet.scale_up()
+        t1 = fleet.scale_up()
+        assert (t0, t1) == ("replica0", "replica1")
+        assert fleet.count() == 2
+        assert fleet.scale_down(drain_timeout=15.0) == "replica1"
+        assert fleet.count() == 1
+        assert fleet.scale_down(drain_timeout=15.0) == "replica0"
+        assert fleet.count() == 0
+        assert fleet.scale_down() is None
+    finally:
+        fleet.stop_all()
+
+
+def test_local_replica_fleet_reaps_dead(tmp_path):
+    fleet = LocalReplicaFleet(
+        [sys.executable, "-c", "pass"], str(tmp_path / "replicas"))
+    try:
+        fleet.scale_up()
+        deadline = time.monotonic() + 10.0
+        while fleet.count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fleet.count() == 0  # exited process reaped from census
+    finally:
+        fleet.stop_all()
+
+
+def test_local_replica_fleet_exports_identity(tmp_path):
+    """Replicas get FAA_HOST_ID + the port-dir/tag args — the fleet
+    supervision idiom (attempt-gated faults stay addressable)."""
+    script = (
+        "import json, os, sys\n"
+        "print(json.dumps({'host_id': os.environ.get('FAA_HOST_ID'),"
+        " 'attempt': os.environ.get('FAA_ATTEMPT'),"
+        " 'argv': sys.argv[1:]}))\n")
+    out_path = tmp_path / "out.json"
+    wrapper = (f"import subprocess, sys\n"
+               f"r = subprocess.run([sys.executable, '-c', "
+               f"{script!r}] + sys.argv[1:], capture_output=True, "
+               f"text=True)\n"
+               f"open({str(out_path)!r}, 'w').write(r.stdout)\n")
+    fleet = LocalReplicaFleet([sys.executable, "-c", wrapper],
+                              str(tmp_path / "replicas"))
+    fleet.scale_up()
+    deadline = time.monotonic() + 15.0
+    while not out_path.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    time.sleep(0.2)
+    rec = json.loads(out_path.read_text())
+    assert rec["host_id"] == "0" and rec["attempt"] == "1"
+    assert "--port-dir" in rec["argv"] and "--host-tag" in rec["argv"]
+    assert rec["argv"][rec["argv"].index("--host-tag") + 1] == "replica0"
+    fleet.stop_all()
+
+
+# ----------------------------------------------------------- the CLI
+
+
+def test_autoscaler_cli_parser():
+    from fast_autoaugment_tpu.serve.autoscaler import build_parser
+
+    args = build_parser().parse_args(
+        ["--port-dir", "/tmp/x", "--max-replicas", "5", "--",
+         "python", "-m", "x"])
+    assert args.max_replicas == 5 and args.min_replicas == 1
+    assert args.high_queue == 8.0 and args.cooldown == 10.0
+    assert args.up_polls == 2 and args.down_polls == 5
+    assert args.replica_cmd == ["--", "python", "-m", "x"]
+
+
+def test_autoscaler_cli_bounded_run(tmp_path):
+    """The CLI end to end with a fake replica command: floors the
+    fleet at min-replicas, runs for --scale-seconds, drains, and
+    prints its stats JSON."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-m", "fast_autoaugment_tpu.serve.autoscaler",
+         "--port-dir", str(tmp_path / "replicas"),
+         "--min-replicas", "1", "--max-replicas", "2",
+         "--poll-interval", "0.1", "--scale-seconds", "1.0", "--",
+         sys.executable, "-c", _FAKE_REPLICA],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    stats = json.loads(line)
+    assert stats["replicas"] == 0  # drained on exit
+    assert stats["policy"]["min_replicas"] == 1

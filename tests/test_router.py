@@ -1,0 +1,568 @@
+"""The serving-plane router (serve/router.py + router_cli.py): digest
+rendezvous stability, port-dir discovery, health-aware rotation with
+hysteresis, bounded Retry-After failover, and the FAA_FAULT drill
+verbs — all fast and host-only (stub HTTP replicas, no jax)."""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from fast_autoaugment_tpu.serve.router import (
+    Router,
+    discover_replicas,
+    parse_static_replicas,
+    rendezvous_order,
+)
+from fast_autoaugment_tpu.utils import faultinject
+
+_NAME_SEQ = itertools.count()
+
+
+def _router(**kw) -> Router:
+    """A Router with a unique registry label per test (the metrics
+    registry is process-wide; shared names would accumulate)."""
+    kw.setdefault("name", f"rt{next(_NAME_SEQ)}")
+    return Router(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env():
+    saved = os.environ.pop("FAA_FAULT", None)
+    faultinject.reset()
+    yield
+    if saved is None:
+        os.environ.pop("FAA_FAULT", None)
+    else:
+        os.environ["FAA_FAULT"] = saved
+    faultinject.reset()
+
+
+class StubReplica:
+    """A controllable upstream: /readyz verdict flips on demand,
+    /augment answers a configurable status + headers, and every
+    routed request is recorded."""
+
+    def __init__(self):
+        self.ready = True
+        self.augment_status = 200
+        self.augment_headers: dict = {}
+        self.augment_body = b"ok"
+        self.requests: list[dict] = []
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _answer(self, code, body, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    with stub._lock:
+                        ok = stub.ready
+                    self._answer(200 if ok else 503, b"{}")
+                else:
+                    self._answer(404, b"{}")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                body = self.rfile.read(length) if length else b""
+                with stub._lock:
+                    stub.requests.append({
+                        "path": self.path,
+                        "digest": self.headers.get("X-FAA-Policy-Digest"),
+                        "deadline": self.headers.get("X-FAA-Deadline-Ms"),
+                        "n": len(body)})
+                    code = stub.augment_status
+                    headers = dict(stub.augment_headers)
+                    out = stub.augment_body
+                self._answer(code, out, headers)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.port = self.httpd.server_address[1]
+
+    @property
+    def n_requests(self) -> int:
+        with self._lock:
+            return len(self.requests)
+
+    def set_ready(self, ok: bool) -> None:
+        with self._lock:
+            self.ready = ok
+
+    def set_augment(self, status: int, headers: dict | None = None) -> None:
+        with self._lock:
+            self.augment_status = status
+            self.augment_headers = dict(headers or {})
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def stubs():
+    reps = [StubReplica() for _ in range(3)]
+    yield reps
+    for r in reps:
+        r.close()
+
+
+def _static(reps) -> list[dict]:
+    return [{"tag": f"r{i}", "host": "127.0.0.1", "port": r.port}
+            for i, r in enumerate(reps)]
+
+
+# ------------------------------------------------- rendezvous hashing
+
+
+def test_rendezvous_deterministic_and_total():
+    ids = [f"r{i}" for i in range(5)]
+    order = rendezvous_order("abc123", ids)
+    assert sorted(order) == sorted(ids)
+    assert order == rendezvous_order("abc123", list(reversed(ids)))
+
+
+def test_rendezvous_minimal_disruption_on_leave_and_join():
+    """Removing one replica moves ONLY the digests it was primary for;
+    every other digest keeps its primary (the warm-tenant-stability
+    property the affinity model rests on)."""
+    ids = [f"r{i}" for i in range(4)]
+    digests = [f"d{i:04x}" for i in range(64)]
+    primary = {d: rendezvous_order(d, ids)[0] for d in digests}
+    gone = "r2"
+    rest = [i for i in ids if i != gone]
+    for d in digests:
+        new_primary = rendezvous_order(d, rest)[0]
+        if primary[d] != gone:
+            assert new_primary == primary[d], d
+        else:
+            assert new_primary in rest
+    # join back: everything returns to the original assignment
+    for d in digests:
+        assert rendezvous_order(d, ids)[0] == primary[d]
+
+
+def test_rendezvous_spreads_digests():
+    ids = [f"r{i}" for i in range(3)]
+    primaries = {rendezvous_order(f"digest{i}", ids)[0]
+                 for i in range(48)}
+    assert primaries == set(ids)  # no replica starves
+
+
+# ------------------------------------------------------ discovery
+
+
+def test_parse_static_replicas():
+    recs = parse_static_replicas("127.0.0.1:8765, 10.0.0.2:9000")
+    assert [(r["host"], r["port"]) for r in recs] == \
+        [("127.0.0.1", 8765), ("10.0.0.2", 9000)]
+    with pytest.raises(ValueError):
+        parse_static_replicas("no-port")
+
+
+def test_discover_replicas_reads_and_skips_torn(tmp_path):
+    good = {"tag": "replica0", "host": "127.0.0.1", "port": 1234,
+            "pid": 42}
+    (tmp_path / "replica0.json").write_text(json.dumps(good))
+    (tmp_path / "torn.json").write_text('{"host": "x", ')
+    (tmp_path / "notes.txt").write_text("ignored")
+    recs = discover_replicas(str(tmp_path))
+    assert len(recs) == 1 and recs[0]["tag"] == "replica0"
+    assert recs[0]["port"] == 1234
+    assert discover_replicas(str(tmp_path / "missing")) == []
+
+
+def test_port_dir_join_and_leave(tmp_path, stubs):
+    """Replicas joining the port-dir enter the table (and rotation
+    after proving readyz); a removed record leaves the table."""
+    d = tmp_path / "replicas"
+    d.mkdir()
+    r = _router(port_dir=str(d))
+    r.refresh_discovery()
+    assert r.stats()["replicas"] == {}
+    for i, stub in enumerate(stubs[:2]):
+        (d / f"replica{i}.json").write_text(json.dumps(
+            {"tag": f"replica{i}", "host": "127.0.0.1",
+             "port": stub.port}))
+    r.refresh_discovery()
+    r.poll_once()
+    st = r.stats()
+    assert sorted(st["replicas"]) == ["replica0", "replica1"]
+    assert st["in_rotation"] == ["replica0", "replica1"]
+    (d / "replica1.json").unlink()
+    r.refresh_discovery()
+    assert sorted(r.stats()["replicas"]) == ["replica0"]
+
+
+def test_static_replicas_survive_port_dir_reconciliation(tmp_path, stubs):
+    """Static (configured) membership is never dropped by port-dir
+    reconciliation — only discovered records can leave."""
+    d = tmp_path / "replicas"
+    d.mkdir()
+    (d / "dyn0.json").write_text(json.dumps(
+        {"tag": "dyn0", "host": "127.0.0.1", "port": stubs[1].port}))
+    r = _router(port_dir=str(d),
+                static_replicas=[{"tag": "stat0", "host": "127.0.0.1",
+                                  "port": stubs[0].port}])
+    r.refresh_discovery()
+    assert sorted(r.stats()["replicas"]) == ["dyn0", "stat0"]
+    (d / "dyn0.json").unlink()
+    r.refresh_discovery()
+    assert sorted(r.stats()["replicas"]) == ["stat0"]
+
+
+def test_relaunched_replica_new_port_reproves(tmp_path, stubs):
+    d = tmp_path / "replicas"
+    d.mkdir()
+    (d / "replica0.json").write_text(json.dumps(
+        {"tag": "replica0", "host": "127.0.0.1", "port": stubs[0].port}))
+    r = _router(port_dir=str(d))
+    r.refresh_discovery()
+    r.poll_once()
+    assert r.stats()["in_rotation"] == ["replica0"]
+    # supervisor relaunch on a fresh port: must re-prove readiness
+    (d / "replica0.json").write_text(json.dumps(
+        {"tag": "replica0", "host": "127.0.0.1", "port": stubs[1].port}))
+    r.refresh_discovery()
+    assert r.stats()["in_rotation"] == []
+    r.poll_once()
+    assert r.stats()["in_rotation"] == ["replica0"]
+
+
+# ------------------------------------------------- rotation hysteresis
+
+
+def test_rotation_eject_and_readmit_hysteresis(stubs):
+    r = _router(static_replicas=_static(stubs), eject_after=2,
+                readmit_after=2)
+    r.poll_once()
+    assert r.stats()["in_rotation"] == []  # one ok poll < readmit_after
+    r.poll_once()
+    assert sorted(r.stats()["in_rotation"]) == ["r0", "r1", "r2"]
+    stubs[1].set_ready(False)
+    r.poll_once()
+    # hysteresis: ONE failed poll does not eject
+    assert "r1" in r.stats()["in_rotation"]
+    r.poll_once()
+    assert "r1" not in r.stats()["in_rotation"]
+    # recovery: two good polls readmit
+    stubs[1].set_ready(True)
+    r.poll_once()
+    assert "r1" not in r.stats()["in_rotation"]
+    r.poll_once()
+    assert "r1" in r.stats()["in_rotation"]
+
+
+def test_unreachable_replica_ejects(stubs):
+    recs = _static(stubs)
+    stubs[2].close()  # port now refuses connections
+    r = _router(static_replicas=recs, eject_after=1, readmit_after=1)
+    r.poll_once()
+    st = r.stats()
+    assert "r2" not in st["in_rotation"]
+    assert sorted(st["in_rotation"]) == ["r0", "r1"]
+    assert "unreachable" in st["replicas"]["r2"]["last_reason"]
+
+
+# ------------------------------------------------------- routing
+
+
+def _ready(r: Router, n: int = 1):
+    for _ in range(n):
+        r.poll_once()
+
+
+def test_forward_digest_affinity_lands_on_primary(stubs):
+    r = _router(static_replicas=_static(stubs), readmit_after=1)
+    _ready(r)
+    tags = ["r0", "r1", "r2"]
+    for digest in ("aaaa11", "bbbb22", "cccc33", "dddd44"):
+        primary = rendezvous_order(digest, tags)[0]
+        idx = tags.index(primary)
+        before = stubs[idx].n_requests
+        status, _h, body, routed = r.forward(
+            "POST", "/augment", b"x", {"Content-Length": "1"}, digest)
+        assert status == 200 and routed == primary
+        assert stubs[idx].n_requests == before + 1
+    st = r.stats()
+    assert st["affinity"]["hit_rate"] == 1.0
+    assert st["outcomes"]["ok"] == 4 and st["failovers"] == 0
+
+
+def test_forward_headers_pass_through(stubs):
+    r = _router(static_replicas=_static(stubs), readmit_after=1)
+    _ready(r)
+    r.forward("POST", "/augment", b"xy",
+              {"Content-Length": "2", "X-FAA-Policy-Digest": "abcd12",
+               "X-FAA-Deadline-Ms": "250"}, "abcd12")
+    rec = [q for s in stubs for q in s.requests][0]
+    assert rec["digest"] == "abcd12" and rec["deadline"] == "250"
+    assert rec["n"] == 2
+
+
+def test_forward_failover_on_503_honors_retry_after(stubs):
+    """A 429/503 upstream answer fails the request over AND backs the
+    replica off for its Retry-After window — new traffic routes around
+    it until the window passes."""
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                failover_attempts=2)
+    _ready(r)
+    digest = "feed01"
+    tags = ["r0", "r1", "r2"]
+    order = rendezvous_order(digest, tags)
+    primary_stub = stubs[tags.index(order[0])]
+    second_tag = order[1]
+    primary_stub.set_augment(429, {"Retry-After": "30"})
+    status, _h, _b, routed = r.forward(
+        "POST", "/augment", b"x", {"Content-Length": "1"}, digest)
+    assert status == 200 and routed == second_tag
+    st = r.stats()
+    assert st["failovers"] == 1
+    assert st["replicas"][order[0]]["backing_off"] is True
+    # the backoff window steers the NEXT request straight to the
+    # second candidate — no repeat attempt against the cooling replica
+    before = primary_stub.n_requests
+    status, _h, _b, routed = r.forward(
+        "POST", "/augment", b"x", {"Content-Length": "1"}, digest)
+    assert status == 200 and routed == second_tag
+    assert primary_stub.n_requests == before
+    assert r.stats()["affinity"]["misses"] >= 2
+
+
+def test_forward_bounded_failover_passes_through_last_answer(stubs):
+    """Every candidate rejecting: the router answers with the LAST
+    upstream rejection (Retry-After included) instead of retrying
+    forever — the bounded-failover contract."""
+    for s in stubs:
+        s.set_augment(503, {"Retry-After": "7"})
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                failover_attempts=2)
+    _ready(r)
+    status, headers, _b, _routed = r.forward(
+        "POST", "/augment", b"x", {"Content-Length": "1"}, "cafe55")
+    assert status == 503
+    assert any(k.lower() == "retry-after" and v == "7"
+               for k, v in headers.items())
+    assert sum(s.n_requests for s in stubs) == 3  # 1 + failover_attempts
+    assert r.stats()["outcomes"]["upstream_reject"] == 1
+
+
+def test_forward_no_replica_is_structured_503(stubs):
+    r = _router(static_replicas=_static(stubs))  # nothing polled yet
+    status, headers, body, routed = r.forward(
+        "POST", "/augment", b"x", {"Content-Length": "1"}, "ab")
+    assert status == 503 and routed is None
+    assert json.loads(body)["type"] == "no_replica"
+    assert r.stats()["outcomes"]["no_replica"] == 1
+
+
+def test_forward_transport_failure_fails_over(stubs):
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                failover_attempts=2)
+    _ready(r)
+    digest = "dead77"
+    tags = ["r0", "r1", "r2"]
+    order = rendezvous_order(digest, tags)
+    stubs[tags.index(order[0])].close()  # primary vanishes post-poll
+    status, _h, _b, routed = r.forward(
+        "POST", "/augment", b"x", {"Content-Length": "1"}, digest)
+    assert status == 200 and routed == order[1]
+
+
+def test_digestless_requests_round_robin(stubs):
+    r = _router(static_replicas=_static(stubs), readmit_after=1)
+    _ready(r)
+    for _ in range(6):
+        status, _h, _b, _routed = r.forward(
+            "POST", "/augment", b"x", {"Content-Length": "1"}, None)
+        assert status == 200
+    counts = [s.n_requests for s in stubs]
+    assert counts == [2, 2, 2]
+
+
+# ------------------------------------------------- FAA_FAULT verbs
+
+
+def test_fault_grammar_parses_new_verbs():
+    faults = faultinject.parse_fault_spec(
+        "replica_down@request=5;readyz_flap@period=3")
+    assert [f["kind"] for f in faults] == ["replica_down", "readyz_flap"]
+    assert faults[0]["request"] == 5 and faults[1]["period"] == 3
+    with pytest.raises(ValueError):
+        faultinject.parse_fault_spec("replica_down@step=5")  # wrong key
+    with pytest.raises(ValueError):
+        faultinject.parse_fault_spec("readyz_flap@request=1")
+
+
+def test_replica_down_fault_ejects_deterministic_victim(stubs):
+    """replica_down@request=N: after N routed requests the first
+    sorted replica is declared dead at the health-poll seam — latched,
+    like a killed process — and traffic fails over."""
+    os.environ["FAA_FAULT"] = "replica_down@request=2"
+    faultinject.reset()
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                eject_after=1, failover_attempts=2)
+    _ready(r)
+    assert len(r.stats()["in_rotation"]) == 3
+    for _ in range(2):
+        assert r.forward("POST", "/augment", b"x",
+                         {"Content-Length": "1"}, "aa11")[0] == 200
+    r.poll_once()  # the seam consults the routed-request counter
+    st = r.stats()
+    assert "r0" not in st["in_rotation"]  # sorted-first victim
+    assert st["replicas"]["r0"]["forced_down"] is True
+    # the dead replica stays dead (latched), traffic keeps flowing
+    r.poll_once()
+    assert "r0" not in r.stats()["in_rotation"]
+    for digest in ("x1", "x2", "x3", "x4"):
+        assert r.forward("POST", "/augment", b"x",
+                         {"Content-Length": "1"}, digest)[0] == 200
+
+
+def test_readyz_flap_fault_cycles_rotation(stubs):
+    """readyz_flap@period=P alternates the victim's verdict every P
+    polls: with eject_after=readmit_after=1 the rotation census
+    follows the flap — the hysteresis-drill fixture."""
+    os.environ["FAA_FAULT"] = "readyz_flap@period=2"
+    faultinject.reset()
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                eject_after=1)
+    seen = []
+    for _ in range(8):
+        r.poll_once()
+        seen.append("r0" in r.stats()["in_rotation"])
+    # rounds 1-2 up, 3-4 down, 5-6 up, 7-8 down
+    assert seen == [True, True, False, False, True, True, False, False]
+
+
+def test_readyz_flap_hysteresis_rides_through_short_flap(stubs):
+    """With eject_after above the flap period the rotation never
+    ejects — the hysteresis absorbs the flapping backend."""
+    os.environ["FAA_FAULT"] = "readyz_flap@period=1"
+    faultinject.reset()
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                eject_after=2)
+    for _ in range(6):
+        r.poll_once()
+        assert "r0" in r.stats()["in_rotation"] or \
+            r.stats()["poll_round"] < 2
+
+
+# ----------------------------------------------------- cli + handler
+
+
+def test_router_cli_parser_defaults():
+    from fast_autoaugment_tpu.serve.router_cli import build_parser
+
+    args = build_parser().parse_args(["--port-dir", "/tmp/x"])
+    assert args.poll_interval == 0.5 and args.eject_after == 2
+    assert args.readmit_after == 1 and args.failover_attempts == 2
+    assert args.port == 8780 and args.telemetry == "off"
+
+
+def test_router_http_handler_end_to_end(stubs):
+    """The router's own HTTP surface over stub replicas: /augment
+    proxies (with the routed-to header), /readyz reflects rotation,
+    /stats carries the topology."""
+    from http.client import HTTPConnection
+
+    from fast_autoaugment_tpu.serve.router_cli import make_router_handler
+
+    r = _router(static_replicas=_static(stubs), readmit_after=1)
+    _ready(r)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_router_handler(r))
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    try:
+        def call(method, path, body=None, headers=None):
+            conn = HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp, data
+
+        resp, data = call("GET", "/readyz")
+        assert resp.status == 200 and json.loads(data)["in_rotation"] == 3
+        resp, data = call("POST", "/augment", body=b"imgs",
+                          headers={"X-FAA-Policy-Digest": "aa77"})
+        assert resp.status == 200 and data == b"ok"
+        assert resp.getheader("X-FAA-Routed-To") in ("r0", "r1", "r2")
+        resp, data = call("GET", "/stats")
+        st = json.loads(data)
+        assert st["affinity"]["hits"] == 1
+        resp, data = call("GET", "/metrics")
+        assert resp.status == 200
+        assert "faa_router_requests_total" in data.decode()
+        resp, data = call("POST", "/augment", body=b"")
+        assert resp.status == 400  # empty body refused at the router
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_rotation_events_journaled(tmp_path, stubs):
+    """Eject/readmit transitions land as typed rotation journal
+    events (the faa_status serving-section source)."""
+    from fast_autoaugment_tpu.core import telemetry as T
+
+    T.enable_telemetry(str(tmp_path / "tel"), tb_bridge=False)
+    try:
+        r = _router(static_replicas=_static(stubs), readmit_after=1,
+                    eject_after=1)
+        r.poll_once()
+        stubs[0].set_ready(False)
+        r.poll_once()
+        stubs[0].set_ready(True)
+        r.poll_once()
+        T.journal_flush()
+        import glob
+
+        recs = []
+        for path in glob.glob(str(tmp_path / "tel" / "journal-*.jsonl")):
+            with open(path) as fh:
+                recs += [json.loads(ln) for ln in fh if ln.strip()]
+        rot = [x for x in recs if x["type"] == "rotation"]
+        actions = [(x["action"], x["replica"]) for x in rot]
+        assert ("eject", "r0") in actions and ("readmit", "r0") in actions
+    finally:
+        T._disable_for_tests()
+
+
+def test_poll_loop_thread_lifecycle(tmp_path, stubs):
+    d = tmp_path / "replicas"
+    d.mkdir()
+    for i, stub in enumerate(stubs):
+        (d / f"replica{i}.json").write_text(json.dumps(
+            {"tag": f"replica{i}", "host": "127.0.0.1",
+             "port": stub.port}))
+    r = _router(port_dir=str(d), poll_interval_s=0.05, readmit_after=1)
+    r.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len(r.stats()["in_rotation"]) == 3:
+                break
+            time.sleep(0.05)
+        assert len(r.stats()["in_rotation"]) == 3
+    finally:
+        r.stop()

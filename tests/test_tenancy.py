@@ -1,0 +1,477 @@
+"""Multi-policy tenancy (serve/policy_server.py TenantPool +
+serve_cli tenancy surface): digest identity, LRU admit/evict with
+dispatch-boundary retirement, one-tenant-per-batch coalescing,
+cold-warm-then-serve, and the HTTP digest header — fast and host-only
+(DummyApplier, no XLA)."""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.serve.policy_server import (
+    PolicyServer,
+    TenantNotResidentError,
+    TenantPool,
+    policy_digest,
+)
+
+IMG = 8
+
+
+class DummyApplier:
+    """Host-only applier with a settable digest: shifts pixels by
+    `delta` so tests can tell WHICH tenant served a request."""
+
+    def __init__(self, delta=1.0, digest="default00000", dispatch="exact",
+                 max_batch=8, wall_s=0.0):
+        self.delta = float(delta)
+        self.digest = digest
+        self.dispatch = dispatch
+        self.max_batch = max_batch
+        self.image = IMG
+        self.channels = 3
+        self.num_sub = 1
+        self.shapes = (max_batch,)
+        self.wall_s = float(wall_s)
+        self.calls = 0
+
+    def apply(self, images, keys):
+        self.calls += 1
+        if self.wall_s:
+            time.sleep(self.wall_s)
+        return np.asarray(images, np.float32) + self.delta
+
+
+def _images(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, IMG, IMG, 3)).astype(np.float32)
+
+
+def _keys(n):
+    return np.zeros((n, 2), np.uint32)
+
+
+def _srv(default=None, capacity=2, **kw) -> PolicyServer:
+    return PolicyServer(default or DummyApplier(),
+                        tenant_capacity=capacity, max_wait_ms=1, **kw)
+
+
+# ------------------------------------------------------ digest identity
+
+
+def test_policy_digest_stable_and_distinct():
+    a = np.zeros((1, 2, 3), np.float32)
+    b = np.ones((1, 2, 3), np.float32)
+    assert policy_digest(a) == policy_digest(a)
+    assert policy_digest(a) != policy_digest(b)
+    assert len(policy_digest(a)) == 12
+    # shape participates: a [2,1,3] zero tensor is a DIFFERENT policy
+    assert policy_digest(a) != policy_digest(np.zeros((2, 1, 3),
+                                                      np.float32))
+    # dtype-normalizing: int input digests like its float32 image
+    assert policy_digest(np.zeros((1, 2, 3), np.int32)) == policy_digest(a)
+
+
+# ------------------------------------------------------ the TenantPool
+
+
+def test_pool_lru_admit_evict_order():
+    pool = TenantPool(2, server_id="t0")
+    pool.admit("aaa", "ap_a")
+    pool.admit("bbb", "ap_b")
+    assert pool.resident_digests() == ["aaa", "bbb"]
+    # touching aaa bumps it MRU; admitting ccc evicts bbb (the LRU)
+    assert pool.lookup_submit("aaa") == "ap_a"
+    evicted = pool.admit("ccc", "ap_c")
+    assert evicted == ["bbb"]
+    assert pool.resident_digests() == ["aaa", "ccc"]
+    # bbb is retiring: invisible to new submissions, still
+    # dispatchable for queued work
+    assert pool.lookup_submit("bbb") is None
+    assert pool.lookup_dispatch("bbb") == "ap_b"
+
+
+def test_pool_retirement_waits_for_queued_work():
+    """The dispatch-boundary eviction contract: a retiring tenant with
+    queued work survives sweeps until its work drains."""
+    pool = TenantPool(1, server_id="t1")
+    pool.admit("old", "ap_old")
+    pool.track_submit("old")
+    pool.admit("new", "ap_new")  # old starts retiring with 1 queued
+    assert pool.sweep() == []    # queued work: NOT swept
+    assert pool.lookup_dispatch("old") == "ap_old"
+    pool.track_done("old")
+    assert pool.sweep() == ["old"]
+    assert pool.lookup_dispatch("old") is None
+
+
+def test_pool_readmit_resurrects_retiring():
+    pool = TenantPool(1, server_id="t2")
+    pool.admit("x", "ap1")
+    pool.admit("y", "ap2")      # x retires
+    assert pool.lookup_submit("x") is None
+    pool.admit("x", "ap1b")     # re-admitted before the sweep
+    assert pool.lookup_submit("x") == "ap1b"
+    assert pool.lookup_submit("y") is None  # y took x's place retiring
+    snap = pool.snapshot()
+    assert snap["resident"] == ["x"] and snap["retiring"] == ["y"]
+    assert snap["evicts"] == 2
+
+
+# ----------------------------------------------- server-level tenancy
+
+
+def test_submit_unknown_digest_typed_error():
+    srv = _srv()
+    with pytest.raises(TenantNotResidentError) as ei:
+        srv.submit(_images(1), _keys(1), digest="nope00000000")
+    assert ei.value.digest == "nope00000000"
+    assert ei.value.resident == ()
+
+
+def test_submit_digest_disabled_tenancy_typed_error():
+    srv = PolicyServer(DummyApplier(digest="def000000000"))
+    with pytest.raises(TenantNotResidentError):
+        srv.submit(_images(1), _keys(1), digest="other0000000")
+    # the default applier's own digest is always servable
+    p = srv.submit(_images(1), _keys(1), digest="def000000000")
+    assert p.digest is None  # normalized to the pinned default
+
+
+def test_warm_tenant_and_serve_by_digest():
+    default = DummyApplier(1.0, digest="def000000000")
+    srv = _srv(default)
+    tenant = DummyApplier(7.0, digest="aaa000000000")
+    info = srv.warm_tenant(tenant)
+    assert info["digest"] == "aaa000000000" and info["evicted"] == []
+    srv.start()
+    try:
+        imgs = _images(2)
+        out_t = srv.result(srv.submit(imgs, _keys(2),
+                                      digest="aaa000000000"), timeout=10.0)
+        out_d = srv.result(srv.submit(imgs, _keys(2)), timeout=10.0)
+        assert np.all(out_t - imgs == 7.0)
+        assert np.all(out_d - imgs == 1.0)
+        assert tenant.calls == 1 and default.calls == 1
+    finally:
+        srv.stop()
+    st = srv.stats()
+    assert st["tenancy"]["resident"] == ["aaa000000000"]
+    assert st["default_digest"] == "def000000000"
+    assert st["tenancy"]["admits"] == 1
+
+
+def test_warm_tenant_validates_contract():
+    srv = _srv(DummyApplier(max_batch=8, digest="def000000000"))
+    with pytest.raises(ValueError):  # no digest
+        srv.warm_tenant(DummyApplier(digest=None))
+    with pytest.raises(ValueError):  # the pinned default's digest
+        srv.warm_tenant(DummyApplier(digest="def000000000"))
+    with pytest.raises(ValueError):  # smaller AOT coverage
+        srv.warm_tenant(DummyApplier(max_batch=2, digest="aaa"))
+    with pytest.raises(ValueError):  # dispatch-mode mismatch
+        srv.warm_tenant(DummyApplier(dispatch="grouped", digest="bbb"))
+    bad = DummyApplier(digest="ccc")
+    bad.image = 16
+    with pytest.raises(ValueError):  # geometry mismatch
+        srv.warm_tenant(bad)
+    with pytest.raises(RuntimeError):  # tenancy off entirely
+        PolicyServer(DummyApplier()).warm_tenant(
+            DummyApplier(digest="ddd"))
+
+
+def test_lru_eviction_rejects_new_but_drains_queued():
+    """Capacity pressure: the evicted tenant's QUEUED request still
+    completes on its applier (zero dropped in-flight), while a NEW
+    submission for it gets the typed cold error."""
+    default = DummyApplier(0.0, digest="def000000000")
+    srv = _srv(default, capacity=1)
+    ap_a = DummyApplier(3.0, digest="aaa000000000")
+    ap_b = DummyApplier(5.0, digest="bbb000000000")
+    srv.warm_tenant(ap_a)
+    imgs = _images(1)
+    queued = srv.submit(imgs, _keys(1), digest="aaa000000000")
+    evicted = srv.warm_tenant(ap_b)["evicted"]  # a starts retiring
+    assert evicted == ["aaa000000000"]
+    with pytest.raises(TenantNotResidentError):
+        srv.submit(imgs, _keys(1), digest="aaa000000000")
+    srv.start()
+    try:
+        out = srv.result(queued, timeout=10.0)
+        assert np.all(out - imgs == 3.0)  # served by the RETIRING applier
+        out_b = srv.result(srv.submit(imgs, _keys(1),
+                                      digest="bbb000000000"), timeout=10.0)
+        assert np.all(out_b - imgs == 5.0)
+        # the dispatch boundary swept the drained retiree
+        deadline = time.monotonic() + 5.0
+        while srv._tenants.snapshot()["retiring"] \
+                and time.monotonic() < deadline:
+            srv.augment(imgs, _keys(1), timeout=10.0)  # drive boundaries
+        assert srv._tenants.snapshot()["retiring"] == []
+    finally:
+        srv.stop()
+
+
+def test_batches_never_mix_tenants():
+    """Interleaved digests queued while the worker is down: every
+    dispatch binds ONE applier (outputs homogeneous per request) and
+    FIFO order survives the tenant-boundary carry."""
+    default = DummyApplier(1.0, digest="def000000000", max_batch=8)
+    srv = _srv(default, capacity=2, max_batch=8)
+    ap_a = DummyApplier(10.0, digest="aaa000000000", max_batch=8)
+    srv.warm_tenant(ap_a)
+    imgs = _images(2)
+    pend = []
+    for i in range(6):
+        digest = "aaa000000000" if i % 2 else None
+        pend.append(srv.submit(imgs, _keys(2), digest=digest))
+    srv.start()
+    try:
+        for i, p in enumerate(pend):
+            out = srv.result(p, timeout=10.0)
+            want = 10.0 if i % 2 else 1.0
+            deltas = np.unique(out - imgs)
+            assert deltas.size == 1 and deltas[0] == want, \
+                f"request {i}: mixed-tenant batch"
+        # FIFO preserved across the carries
+        for a, b in zip(pend, pend[1:]):
+            assert a.t_done <= b.t_done
+    finally:
+        srv.stop()
+    # 6 alternating-tenant requests = 6 single-tenant dispatches
+    assert default.calls == 3 and ap_a.calls == 3
+
+
+def test_per_tenant_counters_and_gauge():
+    from fast_autoaugment_tpu.core import telemetry
+
+    default = DummyApplier(0.0, digest="def000000000")
+    srv = _srv(default)
+    ap = DummyApplier(2.0, digest="ten000000000")
+    srv.warm_tenant(ap)
+    srv.start()
+    try:
+        for _ in range(3):
+            srv.augment(_images(2), _keys(2), digest="ten000000000",
+                        timeout=10.0)
+    finally:
+        srv.stop()
+    reg = telemetry.registry()
+    reqs = reg.counter("faa_tenant_requests_total", "",
+                       digest="ten000000000", server=srv._server_id)
+    imgs = reg.counter("faa_tenant_images_total", "",
+                       digest="ten000000000", server=srv._server_id)
+    assert int(reqs.value) == 3 and int(imgs.value) == 6
+    gauge = reg.gauge("faa_tenant_resident", "", server=srv._server_id)
+    assert int(gauge.value) == 1
+
+
+def test_cold_warm_under_concurrent_traffic():
+    """The cold-warm-then-swap drill on dummies: traffic to the warm
+    default NEVER errors while a tenant warms and admits off to the
+    side (the p99-unmoved acceptance, minus the timing claim)."""
+    default = DummyApplier(1.0, digest="def000000000")
+    srv = _srv(default).start()
+    imgs = _images(2)
+    errors, results = [], []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                results.append(srv.augment(imgs, _keys(2), timeout=10.0))
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        # "AOT warm" off to the side (simulated cost), then admit
+        slow_build = DummyApplier(9.0, digest="cold00000000")
+        time.sleep(0.05)
+        srv.warm_tenant(slow_build)
+        out = srv.augment(imgs, _keys(2), digest="cold00000000",
+                          timeout=10.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        srv.stop()
+    assert not errors and len(results) > 0
+    assert np.all(out - imgs == 9.0)
+    for r in results:
+        assert np.all(r - imgs == 1.0)  # warm traffic untouched
+
+
+def test_tenancy_off_defaults_identical_stats_shape():
+    """tenant_capacity=0 keeps the historical stream: no tenancy block,
+    digest-less submits untouched."""
+    srv = PolicyServer(DummyApplier())
+    st = srv.stats()
+    assert "tenancy" not in st
+    p = srv.submit(_images(1), _keys(1))
+    assert p.digest is None
+
+
+# -------------------------------------------------- serve_cli surface
+
+
+def _start_http(server, state=None, **kw):
+    from http.server import ThreadingHTTPServer
+
+    from fast_autoaugment_tpu.serve.serve_cli import make_handler
+
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        make_handler(server, server.applier, state=state, **kw))
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def _http(port, method, path, body=None, headers=None, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp, data
+
+
+def _npz_body(imgs):
+    buf = io.BytesIO()
+    np.savez(buf, images=imgs.astype(np.uint8))
+    return buf.getvalue()
+
+
+def test_http_digest_header_selects_tenant():
+    default = DummyApplier(1.0, digest="def000000000")
+    srv = _srv(default).start()
+    srv.warm_tenant(DummyApplier(200.0, digest="aaa000000000"))
+    httpd, port = _start_http(srv)
+    try:
+        imgs = _images(1, seed=2)
+        body = _npz_body(imgs)
+        resp, data = _http(port, "POST", "/augment", body=body,
+                           headers={"X-FAA-Policy-Digest":
+                                    "aaa000000000"})
+        assert resp.status == 200
+        got = np.load(io.BytesIO(data))["images"]
+        ref = np.clip(imgs + 200.0, 0, 255).astype(np.uint8)
+        assert np.array_equal(got, ref)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
+def test_http_cold_digest_structured_503():
+    srv = _srv(DummyApplier(digest="def000000000")).start()
+    httpd, port = _start_http(srv)  # no state: warming impossible
+    try:
+        resp, data = _http(port, "POST", "/augment",
+                           body=_npz_body(_images(1)),
+                           headers={"X-FAA-Policy-Digest":
+                                    "cold00000000"})
+        assert resp.status == 503
+        body = json.loads(data)
+        assert body["type"] == "tenant_cold"
+        assert body["digest"] == "cold00000000"
+        assert body["warming"] is False
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
+def test_http_warm_endpoint_and_background_warm(tmp_path):
+    """POST /tenants/warm admits from a policy file; a cold digest
+    with a --policy-dir recipe kicks the background warm and later
+    requests hit the resident tenant."""
+    from fast_autoaugment_tpu.serve.serve_cli import (
+        ServeState,
+        build_policy_tensor,
+    )
+
+    policy_dir = tmp_path / "policies"
+    policy_dir.mkdir()
+    spec = policy_dir / "b.json"
+    spec.write_text(json.dumps(
+        [[["ShearX", 0.9, 0.1], ["Solarize", 0.3, 0.7]]]))
+    tensor = build_policy_tensor(str(spec))
+    digest_b = policy_digest(tensor)
+
+    def build_applier(policy_tensor):
+        return DummyApplier(50.0, digest=policy_digest(policy_tensor))
+
+    srv = _srv(DummyApplier(1.0, digest="def000000000")).start()
+    state = ServeState(srv, "unused.json", build_applier,
+                       policy_dir=str(policy_dir))
+    httpd, port = _start_http(srv, state)
+    try:
+        # recipe resolution: content digest scan finds b.json
+        assert state.tenant_recipe(digest_b) == str(spec)
+        assert state.tenant_recipe("ffff00000000") is None
+
+        # cold request: 503 + warming=true (recipe exists)
+        resp, data = _http(port, "POST", "/augment",
+                           body=_npz_body(_images(1)),
+                           headers={"X-FAA-Policy-Digest": digest_b})
+        assert resp.status == 503
+        assert json.loads(data)["warming"] is True
+        assert resp.getheader("Retry-After") is not None
+        # the background warm admits; a retry then serves the tenant
+        deadline = time.monotonic() + 10.0
+        status = None
+        while time.monotonic() < deadline:
+            resp, data = _http(port, "POST", "/augment",
+                               body=_npz_body(_images(1)),
+                               headers={"X-FAA-Policy-Digest": digest_b})
+            status = resp.status
+            if status == 200:
+                break
+            time.sleep(0.1)
+        assert status == 200
+        assert digest_b in srv.resident_tenants()
+
+        # the explicit warm endpoint (operator preload): idempotent
+        resp, data = _http(port, "POST", "/tenants/warm",
+                           body=json.dumps({"policy":
+                                            str(spec)}).encode())
+        assert resp.status == 200
+        info = json.loads(data)
+        assert info["warmed"] is True and info["digest"] == digest_b
+        # /stats reports the tenancy block
+        resp, data = _http(port, "GET", "/stats")
+        st = json.loads(data)
+        assert st["tenancy"]["resident"] == [digest_b]
+        # malformed warm bodies answer structured 400
+        resp, data = _http(port, "POST", "/tenants/warm", body=b"{}")
+        assert resp.status == 400
+        resp, data = _http(port, "POST", "/tenants/warm",
+                           body=json.dumps({"policy":
+                                            "/nope.json"}).encode())
+        assert resp.status == 400
+        assert json.loads(data)["type"] == "warm_failed"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
+def test_serve_cli_parser_tenancy_defaults():
+    from fast_autoaugment_tpu.serve.serve_cli import build_parser
+
+    args = build_parser().parse_args(["--policy", "x.json"])
+    assert args.tenant_capacity == 0 and args.policy_dir is None
+    assert args.port_dir is None

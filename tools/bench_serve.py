@@ -147,11 +147,130 @@ def _robustness_stamp(stats: dict) -> dict:
     }
 
 
+def _parse_addr(url: str) -> tuple[str, int]:
+    from urllib.parse import urlparse
+
+    u = urlparse(url if "//" in url else f"http://{url}")
+    return u.hostname or "127.0.0.1", int(u.port or 80)
+
+
+def _http(host: str, port: int, method: str, path: str, body=None,
+          headers=None, timeout: float = 30.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def run_router_load(router_url: str, images_pool, seconds: float,
+                    imgs_per_request: int, digests: list[str],
+                    concurrency: int = 4) -> dict:
+    """Closed-loop HTTP load through a serving-plane ROUTER: N client
+    threads alternate requests across `digests` (mixed multi-policy
+    traffic), HONOR ``Retry-After`` on 429/503 instead of hot
+    retrying, and collect end-to-end latencies.  The result stamps the
+    router's own topology + affinity accounting (``GET /stats``) so
+    the JSON line records WHICH fleet served the numbers."""
+    import io
+    import threading
+
+    import numpy as np
+
+    host, port = _parse_addr(router_url)
+    buf = io.BytesIO()
+    np.savez(buf, images=images_pool[:imgs_per_request].astype(np.uint8))
+    body = buf.getvalue()
+    lat_lock = threading.Lock()
+    lats: list[float] = []
+    outcomes = {"ok": 0, "retried": 0, "failed": 0}
+    stop_at = time.perf_counter() + seconds
+
+    def client(idx: int):
+        k = idx
+        while time.perf_counter() < stop_at:
+            headers = {}
+            if digests:
+                headers["X-FAA-Policy-Digest"] = digests[k % len(digests)]
+            k += 1
+            t0 = time.perf_counter()
+            try:
+                status, rheaders, _data = _http(
+                    host, port, "POST", "/augment", body, headers)
+            except OSError:
+                with lat_lock:
+                    outcomes["failed"] += 1
+                continue
+            if status in (429, 503):
+                # the Retry-After contract: back off what the plane
+                # asked for, never hot-retry
+                try:
+                    ra = float(rheaders.get("Retry-After", "1") or 1)
+                except ValueError:
+                    ra = 1.0
+                with lat_lock:
+                    outcomes["retried"] += 1
+                time.sleep(min(ra, 2.0))
+                continue
+            wall = time.perf_counter() - t0
+            with lat_lock:
+                if status == 200:
+                    outcomes["ok"] += 1
+                    lats.append(wall)
+                else:
+                    outcomes["failed"] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(max(1, concurrency))]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 60.0)
+    wall = time.perf_counter() - t_start
+    lat_ms = np.asarray(lats) * 1e3 if lats else np.asarray([0.0])
+    row = {
+        "requests_ok": outcomes["ok"],
+        "requests_retried": outcomes["retried"],
+        "requests_failed": outcomes["failed"],
+        "rps": round(outcomes["ok"] / wall, 1) if wall > 0 else 0.0,
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99": round(float(np.percentile(lat_ms, 99)), 3),
+            "max": round(float(lat_ms.max()), 3),
+        },
+    }
+    # the router-topology stamp: which replicas, what rotation, what
+    # affinity hit rate produced these numbers
+    try:
+        status, _h, data = _http(host, port, "GET", "/stats", timeout=10.0)
+        if status == 200:
+            row["router_topology"] = json.loads(data)
+    except (OSError, ValueError):
+        row["router_topology"] = None
+    return row
+
+
 def calibrate_capacity(make_server, images_pool, imgs_per_request: int,
                        seconds: float = 0.75) -> float:
     """Closed-loop capacity estimate: keep ``2 x max_batch`` requests
     in flight for `seconds`, return achieved requests/s — the
-    saturation throughput the overload multipliers scale from."""
+    saturation throughput the overload multipliers scale from.
+
+    A 429 (typed overload rejection) is honored the way a production
+    client honors it: BACK OFF ``retry_after_s`` before re-offering.
+    The old immediate hot retry hammered the admission path in a tight
+    loop, inflating the replica's shed counters during calibration and
+    biasing the measured capacity downward (admission-path contention
+    on this 1-core host)."""
+    from fast_autoaugment_tpu.serve.policy_server import (
+        ServerOverloadedError,
+    )
+
     server = make_server()
     try:
         n_window = max(2, 2 * server.max_batch)
@@ -161,8 +280,13 @@ def calibrate_capacity(make_server, images_pool, imgs_per_request: int,
         while time.perf_counter() - t0 < seconds:
             while len(inflight) < n_window:
                 lo = done % (images_pool.shape[0] - imgs_per_request + 1)
-                inflight.append(
-                    server.submit(images_pool[lo:lo + imgs_per_request]))
+                try:
+                    inflight.append(server.submit(
+                        images_pool[lo:lo + imgs_per_request]))
+                except ServerOverloadedError as e:
+                    # honor Retry-After instead of re-offering hot
+                    time.sleep(min(e.retry_after_s, 0.25))
+                    continue
                 done += 1
             server.result(inflight.pop(0), timeout=60.0)
         for p in inflight:
@@ -312,6 +436,19 @@ def main(argv=None) -> int:
     p.add_argument("--qps", type=float, default=200.0)
     p.add_argument("--seconds", type=float, default=5.0)
     p.add_argument("--imgs-per-request", type=int, default=1)
+    # --------------------------------------------------- router mode
+    p.add_argument("--router", default=None, metavar="URL",
+                   help="measure THROUGH a serving-plane router "
+                        "(router_cli) instead of an in-process server: "
+                        "closed-loop HTTP clients honoring Retry-After, "
+                        "with the router topology + affinity stamp in "
+                        "the JSON line (docs/SERVING.md)")
+    p.add_argument("--router-digests", default="",
+                   help="comma-separated policy digests to alternate "
+                        "across requests (mixed multi-policy traffic); "
+                        "empty = no digest header (default policy)")
+    p.add_argument("--router-concurrency", type=int, default=4,
+                   help="closed-loop client threads in --router mode")
     # ------------------------------------------------- overload drill
     p.add_argument("--overload", action="store_true",
                    help="sweep offered QPS past calibrated capacity, "
@@ -343,6 +480,32 @@ def main(argv=None) -> int:
     )
 
     contention = refuse_or_flag_contention(host_contention_stamp())
+
+    if args.router:
+        # host-only HTTP client mode: the plane (router + replicas) is
+        # already up; this process never imports jax
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        pool = rng.integers(
+            0, 256, (max(64, args.imgs_per_request * 2), args.image,
+                     args.image, 3), dtype=np.uint8).astype(np.float32)
+        digests = [d for d in str(args.router_digests).split(",") if d]
+        load = run_router_load(args.router, pool, args.seconds,
+                               args.imgs_per_request, digests,
+                               args.router_concurrency)
+        out = {
+            "metric": "serve_router_latency_ms",
+            "router": args.router,
+            "image": args.image,
+            "imgs_per_request": args.imgs_per_request,
+            "digests": digests,
+            "seconds": args.seconds,
+            **load,
+            **telemetry_stamp(contention=contention),
+        }
+        print(json.dumps(out))
+        return 0
 
     import jax
     import numpy as np

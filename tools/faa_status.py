@@ -16,7 +16,13 @@ Aggregates, across every host that writes under ``--dir``:
   ``launch/workqueue.py::beat_host`` and ``serve_cli
   --heartbeat-dir``): alive / done / STALE verdicts against ``--ttl``;
 - **done markers** (``done/<unit>.json``): units finished per host and
-  the reclaimed-unit evidence (``attempt > 1``).
+  the reclaimed-unit evidence (``attempt > 1``);
+- **the serving plane** (docs/SERVING.md): replica census from
+  ``--port-dir`` discovery records (+ heartbeats and same-host pid
+  probes), in/out-of-rotation verdicts from the router's journaled
+  ``rotation`` events, resident-tenant counts from ``tenant``
+  admit/evict events, and the last N autoscaler ``scale_up``/
+  ``scale_down`` decisions with their metric evidence inline.
 
 Everything is read-only over shared files — safe against a live fleet,
 host-only (no jax import), and exactly the cross-host view no single
@@ -129,8 +135,133 @@ def read_done_markers(root: str) -> list[dict]:
     return out
 
 
+def read_port_records(port_dir: str) -> list[dict]:
+    """Replica-discovery records (``serve_cli --port-dir``): one
+    ``<tag>.json`` per live replica; a drained replica removed its
+    record, so presence ~ membership."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(port_dir))
+    except OSError:
+        return out
+    for name in names:
+        if name.endswith(".json") and not name.startswith("."):
+            rec = _read_json(os.path.join(port_dir, name))
+            if rec and "port" in rec:
+                out.append(rec)
+    return out
+
+
+def _pid_alive(pid: int) -> bool | None:
+    """Same-host liveness probe; None when unknowable (pid 0/other
+    host)."""
+    if not pid:
+        return None
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (OSError, ValueError):
+        return None
+
+
+def serving_plane_status(root: str, journal: list[dict],
+                         beats: dict[str, dict],
+                         port_dir: str | None = None, ttl: float = 60.0,
+                         now: float | None = None,
+                         scale_events: int = 5) -> dict | None:
+    """The serving-plane section: replica census (port-dir records +
+    heartbeats + same-host pid probes), in/out-of-rotation verdicts
+    (the router's journaled ``rotation`` events), resident-tenant
+    counts (net ``tenant`` admit/evict events per host), and the last
+    N autoscaler ``scale_up``/``scale_down`` decisions with their
+    metric evidence.  Read-only over shared files, like everything
+    else here.  None when the dir shows no serving plane at all."""
+    now = time.time() if now is None else now
+    if port_dir is None:
+        cand = os.path.join(root, "replicas")
+        port_dir = cand if os.path.isdir(cand) else None
+    records = read_port_records(port_dir) if port_dir else []
+
+    # rotation: the LAST journaled verdict per replica tag wins
+    rotation: dict[str, dict] = {}
+    tenants: dict[str, set] = {}
+    scales: list[dict] = []
+    for rec in journal:
+        etype = rec.get("type")
+        if etype == "rotation":
+            tag = str(rec.get("replica"))
+            rotation[tag] = {"action": rec.get("action"),
+                             "reason": rec.get("reason"),
+                             "t_wall": rec.get("t_wall")}
+        elif etype == "tenant":
+            key = f"{rec.get('host')}/{rec.get('label')}"
+            cur = tenants.setdefault(key, set())
+            digest = rec.get("digest")
+            if rec.get("action") == "admit":
+                cur.add(digest)
+            elif rec.get("action") == "evict":
+                cur.discard(digest)
+        elif etype in ("scale_up", "scale_down"):
+            scales.append({
+                "action": etype,
+                "replica": rec.get("replica"),
+                "replicas_after": rec.get("replicas_after"),
+                "queue_depth": rec.get("queue_depth"),
+                "shed_rate": rec.get("shed_rate"),
+                "breaker_open": rec.get("breaker_open"),
+                "t_wall": rec.get("t_wall"),
+            })
+    scales.sort(key=lambda s: s.get("t_wall") or 0)
+
+    replicas: dict[str, dict] = {}
+    for rec in records:
+        tag = str(rec.get("tag"))
+        row = {"addr": f"{rec.get('host')}:{rec.get('port')}",
+               "pid": rec.get("pid"),
+               "pid_alive": _pid_alive(rec.get("pid", 0))}
+        beat = beats.get(tag)
+        if beat is None:
+            row["beat"] = "none"
+        elif beat.get("done"):
+            row["beat"] = "done"
+        else:
+            age = now - float(beat.get("heartbeat", 0.0))
+            row["beat"] = "alive" if age <= ttl else f"STALE {age:.0f}s"
+        rot = rotation.get(tag)
+        if rot is None:
+            row["rotation"] = "unknown"
+        else:
+            row["rotation"] = ("in" if rot["action"] == "readmit"
+                               else "OUT")
+            row["rotation_reason"] = rot.get("reason")
+        replicas[tag] = row
+    # rotation verdicts for replicas the router saw but whose record
+    # is gone (killed replica: the eject evidence must not vanish)
+    for tag, rot in rotation.items():
+        if tag not in replicas:
+            replicas[tag] = {"addr": None, "pid": None, "pid_alive": None,
+                             "beat": beats.get(tag, {}).get("done")
+                             and "done" or "none",
+                             "rotation": ("in" if rot["action"] ==
+                                          "readmit" else "OUT"),
+                             "rotation_reason": rot.get("reason")}
+    if not replicas and not tenants and not scales:
+        return None
+    return {
+        "port_dir": port_dir,
+        "replicas": replicas,
+        "resident_tenants": {k: sorted(d for d in v if d)
+                             for k, v in sorted(tenants.items())},
+        "scale_events": scales[-max(0, int(scale_events)):],
+        "scale_event_total": len(scales),
+    }
+
+
 def fleet_status(root: str, ttl: float = 60.0,
-                 now: float | None = None) -> dict:
+                 now: float | None = None,
+                 port_dir: str | None = None) -> dict:
     """The aggregated per-host view (JSON-ready)."""
     now = time.time() if now is None else now
     journal = read_journal(root)
@@ -171,7 +302,7 @@ def fleet_status(root: str, ttl: float = 60.0,
          "reclaimed_from": d.get("reclaimed_from")}
         for d in done if int(d.get("attempt", 1)) > 1
     ]
-    return {
+    out = {
         "dir": os.path.abspath(root),
         "generated_at": now,
         "ttl_s": ttl,
@@ -180,6 +311,11 @@ def fleet_status(root: str, ttl: float = 60.0,
         "reclaimed_units": reclaimed,
         "journal_records": len(journal),
     }
+    serving = serving_plane_status(root, journal, beats,
+                                   port_dir=port_dir, ttl=ttl, now=now)
+    if serving is not None:
+        out["serving"] = serving
+    return out
 
 
 _COLUMNS = (
@@ -215,6 +351,32 @@ def render_table(status: dict) -> str:
         tail += (f"\n  reclaimed: {rec['unit']} attempt {rec['attempt']} "
                  f"finished by {rec['finished_by']} "
                  f"(from {rec['reclaimed_from']})")
+    serving = status.get("serving")
+    if serving:
+        tail += "\n\nserving plane:"
+        for tag, row in sorted(serving["replicas"].items()):
+            alive = row.get("pid_alive")
+            tail += (f"\n  {tag}: {row.get('addr') or '-'}  "
+                     f"rotation={row.get('rotation')}  "
+                     f"beat={row.get('beat')}  "
+                     f"pid={'?' if alive is None else ('up' if alive else 'DEAD')}")
+            if row.get("rotation_reason"):
+                tail += f"  ({row['rotation_reason']})"
+        for key, digests in serving["resident_tenants"].items():
+            tail += (f"\n  tenants {key}: {len(digests)} resident"
+                     f" [{', '.join(digests)}]" if digests else
+                     f"\n  tenants {key}: 0 resident")
+        n_total = serving.get("scale_event_total", 0)
+        shown = serving.get("scale_events", [])
+        if shown:
+            tail += (f"\n  autoscaler: last {len(shown)} of {n_total} "
+                     "scale event(s):")
+            for ev in shown:
+                tail += (f"\n    {ev['action']} -> {ev.get('replica')}"
+                         f" (replicas={ev.get('replicas_after')}, "
+                         f"queue={ev.get('queue_depth')}, "
+                         f"shed_rate={ev.get('shed_rate')}, "
+                         f"breaker={ev.get('breaker_open')})")
     return "\n".join(lines) + "\n" + tail
 
 
@@ -231,12 +393,17 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="emit the aggregate as one JSON object instead "
                         "of the table")
+    p.add_argument("--port-dir", default=None, metavar="DIR",
+                   help="serving-plane replica-discovery dir "
+                        "(serve_cli --port-dir); default: "
+                        "<dir>/replicas when present")
     args = p.parse_args(argv)
 
-    status = fleet_status(args.dir, ttl=args.ttl)
-    if not status["hosts"]:
+    status = fleet_status(args.dir, ttl=args.ttl, port_dir=args.port_dir)
+    if not status["hosts"] and not status.get("serving"):
         print(f"faa_status: nothing under {args.dir} (no journal-*.jsonl, "
-              "no hosts/*.json)", file=sys.stderr)
+              "no hosts/*.json, no serving-plane records)",
+              file=sys.stderr)
         return 2
     if args.json:
         print(json.dumps(status))
