@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-compile bench-pipeline trace status clean reproduce
+.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-compile bench-pipeline bench-fleet-search trace status clean reproduce
 
 # telemetry journal dir for the trace/status targets (override:
 #   make trace TELEMETRY=/shared/run TRACE_OUT=overlap.json)
@@ -97,6 +97,17 @@ bench-compile:
 # FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host, exit 3).
 bench-pipeline:
 	python tools/bench_pipeline.py
+
+# multi-host fleet-search bench: the same seeded search single-host vs
+# a real 1-learner + N-actor process fleet over a shared
+# --fleet-transport dir — round publish->claim / return->apply
+# latencies, learner cost/round vs the ask(K) budget, per-host
+# busy-frac and journal-proven concurrent phase-1/phase-2 lanes on
+# distinct host ids, byte-identity of the artifacts
+# (docs/BENCHMARKS.md "Search pipelining", multi-host section).
+# Honors FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host).
+bench-fleet-search:
+	python tools/bench_fleet_search.py
 
 # render a --telemetry journal dir as a Chrome trace (open the output
 # in chrome://tracing or ui.perfetto.dev): per-thread dispatch spans,

@@ -80,7 +80,8 @@ from fast_autoaugment_tpu.utils.logging import get_logger
 
 logger = get_logger("faa_tpu.fleet")
 
-__all__ = ["expand_hosts", "launch_fleet", "main"]
+__all__ = ["expand_hosts", "launch_fleet", "main", "resolve_roles",
+           "DEFAULT_ENV_PASSTHROUGH"]
 
 
 def expand_hosts(spec: str) -> list[str]:
@@ -208,7 +209,8 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
                env_passthrough: tuple[str, ...], host_retries: int,
                retry_backoff: float, attempts_out: dict,
                elastic: bool = False, workqueue_dir: str | None = None,
-               heartbeat_timeout: float = 0.0, rank_args: bool = True):
+               heartbeat_timeout: float = 0.0, rank_args: bool = True,
+               role: str | None = None):
     """Launch + babysit one host: relaunch on failure (exit 77 included)
     up to `host_retries` times with exponential backoff, SIGKILLing a
     heartbeat-stale (wedged) process first when configured; on final
@@ -240,9 +242,14 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
         attempts_out[host] = attempt
         # FAA_ATTEMPT gates fault-injection specs to one attempt in the
         # process chain (a relaunch re-reads the same FAA_FAULT);
-        # FAA_HOST_ID addresses rank-free replicas (serve host beats)
+        # FAA_HOST_ID addresses rank-free replicas (serve host beats);
+        # FAA_SEARCH_ROLE is the per-host fleet-search role (--roles),
+        # re-exported on every RETRY so a relaunched actor stays an
+        # actor
         envs = (f"{base_envs} FAA_ATTEMPT={attempt} "
-                f"FAA_HOST_ID={host_id}").strip()
+                f"FAA_HOST_ID={host_id}"
+                + (f" FAA_SEARCH_ROLE={shlex.quote(role)}" if role
+                   else "")).strip()
         # NO setsid: the remote command must keep the ssh pty as its
         # controlling terminal so pty teardown HUPs the whole foreground
         # group — a setsid-detached tree would never see the hangup and
@@ -323,17 +330,42 @@ def _supervise(fleet: _Fleet, host_id: int, host: str, command: list[str],
         return
 
 
+#: env vars forwarded to every host launch AND retry by default — the
+#: whole fleet-sharing contract for the compile cache, the telemetry
+#: journal, the serial-baseline dispatch trace, and the fleet-search
+#: role/transport handoff (pinned by tests/test_fleet_search.py)
+DEFAULT_ENV_PASSTHROUGH = ("JAX_PLATFORMS", "FAA_COMPILE_CACHE",
+                           "FAA_TELEMETRY", "FAA_PIPELINE_TRACE",
+                           "FAA_SEARCH_ROLE", "FAA_FLEET_TRANSPORT")
+
+
+def resolve_roles(spec: str | None, num_hosts: int) -> list[str | None]:
+    """``--roles`` to a per-host role list.  A single role broadcasts;
+    otherwise the comma list must match the host count (a silently
+    truncated or recycled role plan is exactly the launch bug this
+    raises on).  None/'' = no role exports (non-search fleets)."""
+    if not spec:
+        return [None] * num_hosts
+    roles = [r.strip() for r in str(spec).split(",") if r.strip()]
+    if len(roles) == 1:
+        return roles * num_hosts
+    if len(roles) != num_hosts:
+        raise ValueError(
+            f"--roles names {len(roles)} role(s) for {num_hosts} host(s) "
+            "— give one role per host (or a single role to broadcast)")
+    return roles
+
+
 def launch_fleet(hosts: list[str], command: list[str],
                  coordinator: str | None,
-                 env_passthrough: tuple[str, ...] = ("JAX_PLATFORMS",
-                                                     "FAA_COMPILE_CACHE",
-                                                     "FAA_TELEMETRY"),
+                 env_passthrough: tuple[str, ...] = DEFAULT_ENV_PASSTHROUGH,
                  host_retries: int = 0,
                  retry_backoff: float = 1.0,
                  elastic: bool = False,
                  workqueue_dir: str | None = None,
                  heartbeat_timeout: float = 0.0,
-                 rank_args: bool = True) -> int:
+                 rank_args: bool = True,
+                 roles: list[str | None] | None = None) -> int:
     """Run `command` on every host over SSH; returns the first genuine
     failure's exit code (0 when every host eventually succeeds).
 
@@ -364,6 +396,10 @@ def launch_fleet(hosts: list[str], command: list[str],
     fleet = _Fleet()
     coordinator = coordinator or f"{hosts[0]}:8476"
     host_retries = max(0, int(host_retries))
+    if roles is None:
+        roles = [None] * len(hosts)
+    if len(roles) != len(hosts):
+        raise ValueError(f"{len(roles)} role(s) for {len(hosts)} host(s)")
 
     def handler(signum, frame):
         logger.info("signal %d: killing fleet", signum)
@@ -381,7 +417,8 @@ def launch_fleet(hosts: list[str], command: list[str],
             target=_supervise,
             args=(fleet, host_id, host, command, coordinator, len(hosts),
                   env_passthrough, host_retries, retry_backoff, attempts,
-                  elastic, workqueue_dir, heartbeat_timeout, rank_args),
+                  elastic, workqueue_dir, heartbeat_timeout, rank_args,
+                  roles[host_id]),
             daemon=True,
         )
         t.start()
@@ -468,6 +505,20 @@ def main(argv=None):
                         "Point it at a directory all hosts mount; the "
                         "worker CLIs pick it up without extra flags "
                         "(core/compilecache.py)")
+    p.add_argument("--roles", default=None, metavar="R1,R2,...",
+                   help="fleet-search role per host (learner/actor), "
+                        "exported as FAA_SEARCH_ROLE to every launch "
+                        "AND retry so search_cli --search-role auto "
+                        "resolves it.  One role broadcasts to all "
+                        "hosts; otherwise the list must match the host "
+                        "count.  Example: --roles learner,actor,actor")
+    p.add_argument("--fleet-transport", default=None, metavar="DIR",
+                   help="shared fleet-search round-transport dir: "
+                        "exported to every host (and every retry) as "
+                        "FAA_FLEET_TRANSPORT, so the worker CLIs pick "
+                        "up the transport without extra flags — the "
+                        "same contract as --compile-cache/--telemetry "
+                        "(docs/RESILIENCE.md 'Fleet search')")
     p.add_argument("--telemetry", default=None, metavar="DIR",
                    help="shared flight-recorder journal dir: exported to "
                         "every host (and every retry) as FAA_TELEMETRY so "
@@ -492,14 +543,22 @@ def main(argv=None):
         # same contract as the compile cache: the env-passthrough list
         # forwards FAA_TELEMETRY to every host launch and retry
         os.environ["FAA_TELEMETRY"] = args.telemetry
+    if args.fleet_transport and args.fleet_transport.lower() != "off":
+        # and again for the fleet-search round transport
+        os.environ["FAA_FLEET_TRANSPORT"] = args.fleet_transport
     hosts = expand_hosts(args.hosts)
+    try:
+        roles = resolve_roles(args.roles, len(hosts))
+    except ValueError as e:
+        p.error(str(e))
     code = launch_fleet(hosts, command, args.coordinator,
                         host_retries=args.host_retries,
                         retry_backoff=args.retry_backoff,
                         elastic=args.elastic,
                         workqueue_dir=args.workqueue,
                         heartbeat_timeout=args.heartbeat_timeout,
-                        rank_args=not args.no_rank_args)
+                        rank_args=not args.no_rank_args,
+                        roles=roles)
     sys.exit(code)
 
 

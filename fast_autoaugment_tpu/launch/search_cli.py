@@ -242,6 +242,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "EMA of observed dispatch wall times (generous "
                         "first-call compile allowance); SECONDS = a fixed "
                         "deadline (docs/RESILIENCE.md)")
+    p.add_argument("--fleet-transport", default=None, metavar="DIR",
+                   help="multi-host MPMD fleet search: promote the "
+                        "--async-pipeline candidate queue to a cross-"
+                        "host round transport under DIR (a directory "
+                        "every host mounts).  The LEARNER host "
+                        "(--search-role learner) trains phase-1 folds, "
+                        "publishes gate-cleared checkpoints, and "
+                        "publishes TPE ask rounds as leased work "
+                        "units; ACTOR hosts (--search-role actor) "
+                        "claim rounds, run the TTA dispatches, and "
+                        "post rewards back.  The fleet reproduces the "
+                        "single-host --async-pipeline artifacts bit "
+                        "for bit when every host shares the same "
+                        "flags; dead actors are reclaimed by the lease "
+                        "TTL.  Default: inherited FAA_FLEET_TRANSPORT "
+                        "(the fleet launcher's --fleet-transport "
+                        "exports it); 'off'/unset = single host "
+                        "(docs/RESILIENCE.md 'Fleet search')")
+    p.add_argument("--search-role", default="auto",
+                   choices=("auto", "learner", "actor"),
+                   help="this host's role in a --fleet-transport "
+                        "search.  'auto' (default) reads "
+                        "FAA_SEARCH_ROLE (the fleet launcher's --roles "
+                        "exports it per host) and falls back to "
+                        "'learner'.  'actor' runs no training and no "
+                        "TPE: it serves published rounds until the "
+                        "learner marks the search done, then exits 0 "
+                        "(preemption/hang map to exit 77 like every "
+                        "other worker)")
+    p.add_argument("--ckpt-publish-timeout", type=float, default=900.0,
+                   help="actor hosts: seconds to wait for a claimed "
+                        "round's fold checkpoint to be published (and "
+                        "digest-match locally) before exiting loudly")
     p.add_argument("--workqueue", default=None, metavar="DIR",
                    help="elastic multi-host scatter: claim phase-1 fold "
                         "trainings and per-fold phase-2 searches off a "
@@ -358,27 +391,98 @@ def main(argv=None):
             metrics_httpd.shutdown()
 
 
-def _build_workqueue(args):
-    """The shared lease queue (or None): owner tag priority is
-    --host-tag, then host<--host-id> (the fleet launcher's stable
-    per-host identity — a relaunch reclaims its own leases
-    immediately), then host<pid>."""
-    if not args.workqueue:
-        return None
+def _owner_tag(args) -> str:
+    """Stable owner id for lease-holding layers: --host-tag, then
+    host<--host-id> (the fleet launcher's per-host identity — a
+    relaunch reclaims its own leases immediately), then host<pid>."""
     import os
 
-    from fast_autoaugment_tpu.launch.workqueue import WorkQueue
-
-    tag = args.host_tag or (
+    return args.host_tag or (
         f"host{args.host_id}" if args.host_id is not None
         else f"host{os.getpid()}")
+
+
+def _build_workqueue(args):
+    """The shared lease queue (or None)."""
+    if not args.workqueue:
+        return None
+    from fast_autoaugment_tpu.launch.workqueue import WorkQueue
+
+    tag = _owner_tag(args)
     wq = WorkQueue(args.workqueue, tag, lease_ttl=args.lease_ttl)
     logger.info("workqueue: owner=%s root=%s lease_ttl=%.1fs",
                 tag, args.workqueue, args.lease_ttl)
     return wq
 
 
+def _resolve_fleet_transport(args):
+    """``(transport, role)``: the cross-host round transport (or None)
+    plus this host's resolved role.  The dir falls back to the
+    FAA_FLEET_TRANSPORT env handoff (the fleet launcher exports it to
+    every host launch and retry, like FAA_COMPILE_CACHE)."""
+    import os
+
+    from fast_autoaugment_tpu.search.pipeline import (
+        FLEET_TRANSPORT_ENV_VAR,
+        FleetTransport,
+        resolve_search_role,
+    )
+
+    role = resolve_search_role(args.search_role)
+    spec = (args.fleet_transport or "").strip()
+    if spec.lower() in ("", "off"):
+        spec = os.environ.get(FLEET_TRANSPORT_ENV_VAR, "").strip()
+    if spec.lower() in ("", "off"):
+        if role == "actor":
+            raise SystemExit(
+                "search_cli: --search-role actor needs a --fleet-"
+                "transport DIR (or the FAA_FLEET_TRANSPORT handoff) — "
+                "an actor host without a transport has nothing to serve")
+        return None, role
+    if args.workqueue:
+        raise SystemExit(
+            "search_cli: --fleet-transport and --workqueue are mutually "
+            "exclusive (rounds-over-hosts vs folds-over-hosts)")
+    transport = FleetTransport(spec, _owner_tag(args),
+                               lease_ttl=args.lease_ttl, role=role)
+    logger.info("fleet transport: role=%s owner=%s root=%s "
+                "lease_ttl=%.1fs", role, transport.owner, spec,
+                args.lease_ttl)
+    return transport, role
+
+
+def _run_actor(args, conf, transport):
+    """The --search-role actor main path: serve published rounds until
+    the learner marks the search done; write no search artifacts."""
+    from fast_autoaugment_tpu.search.driver import search_actor
+
+    stats = search_actor(
+        conf,
+        dataroot=args.dataroot,
+        save_dir=args.save_dir,
+        fleet_transport=transport,
+        cv_num=args.num_fold,
+        cv_ratio=args.cv_ratio,
+        num_policy=args.num_policy,
+        num_op=args.num_op,
+        trial_batch=args.trial_batch,
+        seed=args.seed,
+        aug_dispatch=args.aug_dispatch,
+        aug_groups=args.aug_groups,
+        watchdog=args.watchdog,
+        compile_cache=args.compile_cache,
+        telemetry_spec=args.telemetry,
+        ckpt_timeout=args.ckpt_publish_timeout,
+    )
+    transport.mark_host_done({"rounds_ok": stats["rounds_ok"],
+                              "rounds_err": stats["rounds_err"]})
+    return stats
+
+
 def _run(args, conf, t_start):
+    transport, role = _resolve_fleet_transport(args)
+    if role == "actor":
+        return _run_actor(args, conf, transport)
     work_queue = _build_workqueue(args)
     result = search_policies(
         conf,
@@ -415,6 +519,7 @@ def _run(args, conf, t_start):
         pipeline_actors=args.pipeline_actors,
         pipeline_queue_depth=args.pipeline_queue_depth,
         telemetry_spec=args.telemetry,
+        fleet_transport=transport,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []
@@ -460,9 +565,16 @@ def _run(args, conf, t_start):
     if args.until < 3 or not final_policy_set:
         if work_queue is not None:
             work_queue.mark_host_done()
+        if transport is not None:
+            transport.mark_host_done()
         return persist()
 
     phase3_hb = None
+    if transport is not None:
+        # the learner retrains alone (actors drained on search_done),
+        # but its host beat must stay fresh or the fleet's wedge
+        # detector would SIGKILL a healthy learner mid-retrain
+        phase3_hb = transport.beat
     if work_queue is not None:
         # phase 3 is one unit: exactly one host runs the retrains (a
         # stale lease lets a survivor reclaim them; per-run checkpoints
@@ -554,6 +666,8 @@ def _run(args, conf, t_start):
     if work_queue is not None:
         work_queue.release("phase3", info={"num_runs": num_runs})
         work_queue.mark_host_done()
+    if transport is not None:
+        transport.mark_host_done()
     persist()
     logger.info("search complete: %.3f device-hours on %s",
                 result["tpu_hours_total"], result.get("backend", "?"))

@@ -29,6 +29,19 @@ scatter already makes):
     Completion marker (atomic write): ``{unit, owner, attempt,
     reclaimed_from, info}``.  ``attempt > 1`` is the global
     "this unit was reclaimed" signal any host can read at the end.
+    The ``info`` payload doubles as the REWARD-RETURN channel of the
+    fleet-search round transport (``search/pipeline.py``): an actor
+    host releases a claimed round unit with ``info={"rewards": [...]}``
+    (or ``{"error": ...}``) and the learner host reads it back through
+    :meth:`WorkQueue.done_info`.
+``work/<unit>.json``
+    OPTIONAL published payload (:meth:`WorkQueue.publish_unit`): the
+    dynamic-unit form of the queue.  The original scatter's units
+    (``p1-fold<k>``/``p2-fold<k>``) are known to every host up front;
+    round units are MINTED by the learner at ask time, so the payload
+    file is both the work description (trial ids + proposals) and the
+    discovery surface (:meth:`WorkQueue.open_units` lists payloads
+    without done markers — the actor's claim menu).
 ``hosts/<owner>.json``
     Host-level heartbeat (``beat_host``): consumed by the fleet
     supervisor's wedge detector and by the degraded-mode accounting
@@ -98,7 +111,8 @@ class WorkQueue:
         self._leases = os.path.join(root, "leases")
         self._done = os.path.join(root, "done")
         self._hosts = os.path.join(root, "hosts")
-        for d in (self._leases, self._done, self._hosts):
+        self._work = os.path.join(root, "work")
+        for d in (self._leases, self._done, self._hosts, self._work):
             os.makedirs(d, exist_ok=True)
         #: units THIS host reclaimed from a dead owner (session-local;
         #: the global view comes from the done markers' attempt counts)
@@ -123,6 +137,44 @@ class WorkQueue:
 
     def _host_path(self, owner: str) -> str:
         return os.path.join(self._hosts, f"{_safe(owner)}.json")
+
+    def _work_path(self, unit: str) -> str:
+        return os.path.join(self._work, f"{_safe(unit)}.json")
+
+    # -- dynamic (published) units --------------------------------------
+    def publish_unit(self, unit: str, payload: dict) -> None:
+        """Mint a claimable unit with an atomic payload write (the
+        round-unit verb of the fleet-search transport).  Idempotent:
+        re-publishing after a learner resume rewrites the identical
+        payload (same ids, same proposals — the ledger replay is
+        deterministic), so claimants can never read a torn or
+        half-updated description."""
+        from fast_autoaugment_tpu.search.driver import write_json_atomic
+
+        write_json_atomic(self._work_path(unit), dict(payload, unit=unit))
+
+    def unit_payload(self, unit: str) -> dict | None:
+        """The published payload for `unit`, or None (never torn — the
+        writer is atomic)."""
+        return _read_json(self._work_path(unit))
+
+    def open_units(self, prefix: str = "") -> list[str]:
+        """Published units with NO done marker yet, sorted — the claim
+        menu for actor hosts.  A unit under a live foreign lease still
+        lists (claim() on it just returns False); a done unit never
+        does."""
+        try:
+            names = sorted(os.listdir(self._work))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            unit = name[:-5]
+            if unit.startswith(prefix) and not self.is_done(unit):
+                out.append(unit)
+        return out
 
     # -- host heartbeat ------------------------------------------------
     def beat_host(self, extra: dict | None = None) -> None:
@@ -314,9 +366,16 @@ class WorkQueue:
 
     def done_info(self, unit: str) -> dict | None:
         """The completion marker's ``info`` payload (gate exclusions,
-        baselines — whatever the finishing host stamped), or None."""
+        baselines, posted rewards — whatever the finishing host
+        stamped), or None."""
         rec = _read_json(self._done_path(unit))
         return None if rec is None else rec.get("info") or {}
+
+    def done_record(self, unit: str) -> dict | None:
+        """The FULL completion marker (owner, attempt, completed_at,
+        info) — the reward-return reader needs the provenance fields
+        the plain ``done_info`` view drops."""
+        return _read_json(self._done_path(unit))
 
     def read_lease(self, unit: str) -> dict | None:
         return _read_json(self._lease_path(unit))

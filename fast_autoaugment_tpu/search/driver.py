@@ -79,8 +79,8 @@ from fast_autoaugment_tpu.search.tta import (
 from fast_autoaugment_tpu.train.trainer import train_and_eval, train_folds_stacked
 from fast_autoaugment_tpu.utils.logging import get_logger
 
-__all__ = ["search_policies", "make_search_space", "SearchResult",
-           "resolve_quality_floor", "resolve_fold_stack",
+__all__ = ["search_policies", "search_actor", "make_search_space",
+           "SearchResult", "resolve_quality_floor", "resolve_fold_stack",
            "write_json_atomic", "draw_random_policy_set"]
 
 logger = get_logger("faa_tpu.search")
@@ -538,6 +538,7 @@ def search_policies(
     pipeline_actors: int = 1,
     pipeline_queue_depth: int = 1,
     telemetry_spec: str = "off",
+    fleet_transport=None,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -673,6 +674,24 @@ def search_policies(
     measured serial-vs-async evidence.  Async mode is single-host:
     `work_queue` forces it off (work units already scatter folds).
 
+    `fleet_transport` (a :class:`~fast_autoaugment_tpu.search.pipeline.
+    FleetTransport` over a shared directory, or None) promotes the
+    async pipeline's candidate queue to a CROSS-HOST transport: this
+    process becomes the LEARNER host — it trains phase-1 folds,
+    publishes each gate-cleared fold checkpoint to the fleet the moment
+    the gate clears, and publishes ask rounds as leased work units that
+    dedicated ACTOR hosts (``search_cli --search-role actor``) claim,
+    evaluate, and answer with posted rewards.  The learner buffers
+    out-of-order completions and applies them in trial-id order exactly
+    as the in-process pipeline does, so an N-host fleet reproduces the
+    single-host ``--async-pipeline`` artifacts BIT FOR BIT when
+    launched with the same ``pipeline_actors + pipeline_queue_depth``
+    in-flight window; dead or preempted actor hosts are reclaimed for
+    free by the lease TTL + the fleet ``--elastic`` stack.  Implies
+    ``async_pipeline=on`` (the learner schedule IS the pipeline
+    schedule) and is mutually exclusive with `work_queue` (which
+    scatters whole folds instead of rounds).
+
     `compile_cache` ("off" default / a directory) wires JAX's
     persistent compilation cache through every compile this search
     pays — phase-1 training, TTA, audit, retrains — so a fresh process
@@ -769,6 +788,18 @@ def search_policies(
     pipeline_on = resolve_async_pipeline(async_pipeline)
     pipeline_actors = max(1, int(pipeline_actors))
     pipeline_queue_depth = max(0, int(pipeline_queue_depth))
+    if fleet_transport is not None and work_queue is not None:
+        raise ValueError(
+            "fleet_transport and work_queue are mutually exclusive: the "
+            "round transport scatters ask ROUNDS across actor hosts, the "
+            "lease workqueue scatters whole FOLDS across peer searches")
+    if fleet_transport is not None and not pipeline_on:
+        # the learner schedule IS the pipeline schedule (ask horizon,
+        # reorder buffer, id-order tells) — rounds just dispatch to
+        # actor hosts instead of actor threads
+        logger.info("fleet transport: async pipeline forced ON (the "
+                    "learner's round schedule is the pipeline schedule)")
+        pipeline_on = True
     if pipeline_on and work_queue is not None:
         logger.warning("workqueue: async pipeline forced off — the lease "
                        "queue already scatters folds across hosts")
@@ -1094,6 +1125,9 @@ def search_policies(
         result["final_policy_set"] = []
         result["compile_cache"] = compile_cache_stats()
         result["elapsed_total"] = wall() - watch["start"]
+        if fleet_transport is not None:
+            # no rounds will ever be published: let actor hosts drain
+            fleet_transport.mark_search_done({"until": until})
         return result
 
     # ---------------- phase 2: TPE search per fold --------------------
@@ -1135,15 +1169,16 @@ def search_policies(
                 _write_json_atomic(trials_path, trials_log)
 
         def _record_quarantine(lo, hi, exc, worst):
+            from fast_autoaugment_tpu.search.pipeline import _failure_text
+
+            text = _failure_text(exc)
             logger.warning(
                 "phase2 fold %d trial(s) %d-%d: TTA evaluation FAILED "
-                "(%s: %s) — QUARANTINED with worst-observed reward %.4f; "
-                "the search continues", fold, lo, hi - 1,
-                type(exc).__name__, exc, worst)
+                "(%s) — QUARANTINED with worst-observed reward %.4f; "
+                "the search continues", fold, lo, hi - 1, text, worst)
             for t in range(lo, hi):
                 quarantined.append({
-                    "fold": fold, "trial": t,
-                    "error": f"{type(exc).__name__}: {exc}"})
+                    "fold": fold, "trial": t, "error": text})
 
         def _on_first_ok():
             if trial_batch > 1:
@@ -1153,6 +1188,21 @@ def search_policies(
             elif "tta_executables_first" not in result:
                 result["tta_executables_first"] = executable_census(
                     evaluator.tta_step)
+
+        backend = None
+        on_first_ok = _on_first_ok
+        if fleet_transport is not None:
+            # rounds dispatch to ACTOR HOSTS: publish instead of
+            # enqueue, poll done markers instead of a results queue.
+            # key_seed reproduces this fold's key stream on any host
+            # (key_fold IS PRNGKey(seed * 77 + fold) — see above)
+            backend = fleet_transport.learner_backend(
+                fold, key_seed=seed * 77 + fold, trial_batch=trial_batch,
+                num_policy=num_policy, num_op=num_op)
+            heartbeat = fleet_transport.beat
+            # no local TTA dispatches on the learner: the executable
+            # census belongs to the actor hosts
+            on_first_ok = None
 
         if trace is not None:
             trace.begin_segment(f"p2-fold{fold}")
@@ -1164,8 +1214,9 @@ def search_policies(
                 actors=pipeline_actors, queue_depth=pipeline_queue_depth,
                 num_policy=num_policy, num_op=num_op,
                 persist=_persist, record_quarantine=_record_quarantine,
-                on_first_ok=_on_first_ok,
+                on_first_ok=on_first_ok,
                 should_stop=_pipeline_should_stop, heartbeat=heartbeat,
+                backend=backend,
             )
         finally:
             if trace is not None:
@@ -1362,12 +1413,20 @@ def search_policies(
 
         def _p1_overlap(f):
             try:
-                _phase1_fold(f)
+                _phase1_fold(
+                    f, heartbeat=(fleet_transport.beat
+                                  if fleet_transport is not None else None))
             except BaseException as e:
                 # the in-flight learner must stop at its next round
                 # boundary, not finish the fold against a dying run
                 pipeline_stop_cell.append(e)
                 raise
+            if fleet_transport is not None and f not in excluded_folds \
+                    and os.path.exists(fold_paths[f]):
+                # stream the gate-cleared checkpoint to the fleet the
+                # moment the gate clears — fold f's rounds dispatch to
+                # actor hosts while fold f+1 still trains HERE
+                fleet_transport.publish_checkpoint(f, fold_paths[f])
 
         timeline = run_overlapped_phases(fold_list, _p1_overlap,
                                          _phase2_fold)
@@ -1610,17 +1669,118 @@ def search_policies(
                 "reclaimed and finished by survivors",
                 acct["lost_hosts"], acct["num_reclaimed_units"])
 
+    if fleet_transport is not None:
+        # fleet-search accounting, mirrored from the work-queue stamp:
+        # any round finished at lease attempt > 1 was reclaimed from a
+        # dead/preempted actor host; stale non-done host beats are the
+        # lost hosts.  The trial log itself is already byte-identical
+        # to the single-host run — this stamp is the evidence of HOW it
+        # got there.
+        fleet_transport.beat()
+        acct = fleet_transport.accounting()
+        result["resilience"]["fleet"] = acct
+        result["degraded"] = acct["degraded"]
+        result["lost_hosts"] = acct["lost_hosts"]
+        result["reclaimed_units"] = [r["unit"]
+                                     for r in acct["reclaimed_units"]]
+        result["fleet_transport"] = {
+            "root": fleet_transport.root,
+            "owner": fleet_transport.owner,
+            "window": pipeline_actors + pipeline_queue_depth,
+        }
+        if acct["degraded"]:
+            logger.warning(
+                "fleet search completed DEGRADED: lost_hosts=%s, %d "
+                "round unit(s) reclaimed and finished by surviving "
+                "actors", acct["lost_hosts"], acct["num_reclaimed_units"])
+
     result["final_policy_set"] = final_policy_set
     result["num_sub_policies"] = len(final_policy_set)
 
     _write_json_atomic(os.path.join(save_dir, "final_policy.json"),
                        final_policy_set)
+    if fleet_transport is not None:
+        # terminal marker AFTER the final artifacts land: actor hosts
+        # drain their claim poll and exit 0
+        fleet_transport.mark_search_done(
+            {"num_sub_policies": len(final_policy_set)})
     logger.info(
         "search done: %d sub-policies; phase1 %.1f TPU-s, phase2 %.1f TPU-s",
         len(final_policy_set), result["tpu_secs_phase1"], result["tpu_secs_phase2"],
     )
     result["elapsed_total"] = wall() - watch["start"]
     return result
+
+
+def search_actor(
+    conf,
+    dataroot: str,
+    save_dir: str,
+    fleet_transport,
+    *,
+    cv_num: int = 5,
+    cv_ratio: float = 0.4,
+    num_policy: int = 5,
+    num_op: int = 2,
+    trial_batch: int = 1,
+    seed: int = 0,
+    aug_dispatch: str = "exact",
+    aug_groups: int = 8,
+    watchdog="off",
+    compile_cache: str = "off",
+    telemetry_spec: str = "off",
+    poll_sec: float = 0.5,
+    ckpt_timeout: float = 900.0,
+) -> dict:
+    """ACTOR-host entry point for the multi-host fleet search: no
+    training, no TPE — just the shared ``_FoldEval`` TTA machinery in
+    a claim/evaluate/post loop against the learner's published rounds
+    (``search_cli --search-role actor``; docs/RESILIENCE.md "Fleet
+    search").
+
+    The geometry flags (`trial_batch`, `num_policy`, `num_op`,
+    `aug_dispatch`, ...) must match the learner's — they shape the
+    compiled TTA step, and a payload mismatch raises loudly instead of
+    quarantining every round.  `save_dir` is the SHARED artifact
+    directory the learner writes fold checkpoints into; the transport's
+    published digests gate loading.  Returns the actor's accounting
+    (rounds evaluated/failed, leases lost, units reclaimed from dead
+    peers) once the learner marks the search done."""
+    from fast_autoaugment_tpu.search.pipeline import run_fleet_actor
+
+    configure_compile_cache(compile_cache)
+    telemetry.configure_telemetry(telemetry_spec)
+    mesh = make_mesh()
+    wd = resolve_watchdog(watchdog)
+    evaluator = _FoldEval(
+        conf, dataroot, mesh,
+        num_policy=num_policy, num_op=num_op, cv_ratio=cv_ratio,
+        seed=seed, trial_batch=max(1, int(trial_batch)),
+        aug_dispatch=aug_dispatch, aug_groups=aug_groups, watchdog=wd,
+    )
+
+    def _fold_path(fold: int) -> str:
+        if not 0 <= int(fold) < cv_num:
+            raise ValueError(
+                f"published round names fold {fold} outside this actor's "
+                f"cv_num={cv_num} — launch actors with the learner's flags")
+        return _fold_ckpt_path(save_dir, conf, int(fold), cv_ratio)
+
+    logger.info("fleet actor %s: serving rounds from %s (save_dir %s)",
+                fleet_transport.owner, fleet_transport.root, save_dir)
+    stats = run_fleet_actor(
+        evaluator, fleet_transport, _fold_path,
+        trial_batch=max(1, int(trial_batch)), num_policy=num_policy,
+        num_op=num_op, poll_sec=poll_sec, ckpt_timeout=ckpt_timeout,
+    )
+    stats["watchdog"] = wd.stats()
+    stats["compile_cache"] = compile_cache_stats()
+    logger.info(
+        "fleet actor %s: done — %d round(s) evaluated, %d failed, "
+        "%d lease(s) lost, %d reclaimed", fleet_transport.owner,
+        stats["rounds_ok"], stats["rounds_err"], stats["lease_lost"],
+        len(stats["reclaimed_units"]))
+    return stats
 
 
 def audit_sub_policies(

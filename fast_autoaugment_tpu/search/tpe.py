@@ -276,6 +276,28 @@ class TPE:
     def pending_proposal(self, trial_id: int) -> dict:
         return dict(self._pending[trial_id])
 
+    def pending_rounds(self, trial_batch: int) -> list[list[int]]:
+        """Group the pending trial ids back into their original ask
+        ROUNDS (round ``r`` covers ids ``[r*K, (r+1)*K)``), in id
+        order — the unit the async/fleet schedulers re-dispatch after
+        a resume replay (:func:`~fast_autoaugment_tpu.search.pipeline.
+        replay_trial_log` reconstructed them as ledger-pending)."""
+        K = max(1, int(trial_batch))
+        rounds: list[list[int]] = []
+        for tid in self.pending_ids:
+            if rounds and tid // K == rounds[-1][0] // K:
+                rounds[-1].append(tid)
+            else:
+                rounds.append([tid])
+        return rounds
+
+    def round_payload(self, ids: Sequence[int]) -> list[dict]:
+        """JSON-safe proposal dicts for a round of PENDING ids — the
+        ledger's wire form for the cross-host round transport.  Python's
+        ``json`` round-trips floats exactly (repr-based), so a decoded
+        payload reproduces ``policy_decoder`` output bit for bit."""
+        return [self.pending_proposal(int(t)) for t in ids]
+
     def worst_told(self) -> float:
         """Worst real reward in the ledger (the quarantine placeholder
         value); 0.0 before any tell — mirrors the driver's serial

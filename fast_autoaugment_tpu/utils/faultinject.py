@@ -35,6 +35,12 @@ Kinds and their trigger coordinates:
 ``trial_error@trial=K``
     The phase-2 search raises at trial index K (per fold) — drives the
     quarantine path.
+``sigkill_trial@trial=K``
+    SIGKILL this process once a phase-2 TTA evaluation covers trial
+    index >= K — the unannounced-death case for a fleet-search ACTOR
+    host mid-round (``search/pipeline.py``): its round lease goes
+    stale and a surviving actor reclaims and finishes the round.
+    Accepts the ``attempt=N`` gate like the other signal kinds.
 ``hang@step=K``
     The dispatch covering global step K sleeps FOREVER inside the
     monitored region — the wedged-rendezvous case the watchdog
@@ -111,6 +117,7 @@ _KINDS = {
     "corrupt_ckpt": ("save",),
     "io_error": ("p", "seed"),
     "trial_error": ("trial",),
+    "sigkill_trial": ("trial", "attempt"),
     "hang": ("step", "attempt"),
     "slow": ("step", "factor", "attempt"),
     "stale_lease": ("unit",),
@@ -259,6 +266,15 @@ class FaultPlan:
 
     def trial_error_at(self, trial: int) -> bool:
         return self._take("trial_error", "trial", trial) is not None
+
+    def maybe_kill_trial(self, trial: int) -> None:
+        """Deliver the sigkill_trial verb once an evaluation covers a
+        trial index at or past the spec's coordinate (consulted at the
+        fleet-actor round seam)."""
+        if self._take("sigkill_trial", "trial", trial, at_least=True):
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGKILL)
 
     def dispatch_delay(self, step: int) -> tuple[str, float] | None:
         """Consult the hang/slow verbs at the dispatch seam (with the

@@ -155,6 +155,44 @@ def test_r9_does_not_double_flag_r4_findings():
     assert rules_data.count("R9") == 3
 
 
+def test_r9_covers_fleet_transport_shapes_in_launch_scope():
+    """The PR-13 satellite pin: the cross-host round transport's
+    failure shapes — an actor claim poll with a bare sleep, an
+    unbounded result-queue get — are R9 findings when they live in the
+    launch/ transport layer, and the BOUNDED forms the shipped code
+    uses stay clean."""
+    bad = ("import time, queue\n"
+           "res_q = queue.Queue()\n"
+           "def actor_loop(transport):\n"
+           "    while not transport.search_done():\n"
+           "        time.sleep(0.5)\n"          # unbounded claim poll
+           "    return res_q.get()\n")          # unbounded reward wait
+    rules = _rules(check_source(bad, LAUNCH))
+    assert rules.count("R9") == 1   # the sleep-in-while poll loop
+    assert rules.count("R4") == 1   # launch/: the get is R4's finding
+    good = bad.replace("time.sleep(0.5)",
+                       "time.sleep(0.5)  # robust: allow") \
+              .replace("res_q.get()", "res_q.get(timeout=5.0)")
+    assert not check_source(good, LAUNCH)
+
+
+def test_r7_covers_fleet_transport_shapes_in_search_scope():
+    """The same transport shapes inside search/ (where the learner
+    backend and actor loop actually live) belong to R7 — one engine,
+    scope-keyed rule ids."""
+    bad = ("import time\n"
+           "def wait_checkpoint(rec):\n"
+           "    while rec is None:\n"
+           "        time.sleep(0.5)\n")
+    search_path = "fast_autoaugment_tpu/search/x.py"
+    rules = _rules(check_source(bad, search_path))
+    assert rules == ["R7"]
+    assert "R9" not in rules  # search keeps its own rule id
+    allowed = bad.replace("time.sleep(0.5)",
+                          "time.sleep(0.5)  # robust: allow")
+    assert not check_source(allowed, search_path)
+
+
 def test_r9_event_wait_flagged_and_bounded_ok():
     src = ("import threading\nevt = threading.Event()\nevt.wait()\n")
     assert _rules(check_source(src, CORE)) == ["R9"]

@@ -19,9 +19,16 @@ historical scheduler) and async — and reports, per arm:
   reading as a 7 s "busy" window in both arms).
 
 Phase 1 is trained once in a warmup run and its fold checkpoint is
-copied into both arms' save dirs, so the comparison is pure phase-2
-scheduling.  Honors ``FAA_BENCH_REQUIRE_QUIET=1`` (refuses on a
-contended host, exit 3).
+copied into every arm's save dir, so the comparison is pure phase-2
+scheduling.  Arms run as PAIRED ALTERNATING rounds (serial,async /
+async,serial / ...) and the report takes per-arm MEDIANS — the same
+1-core A/B discipline as ``tools/bench_router.py``: fixed-order arms
+on this host read the allocator's ±2-3% slow drift as signal, and the
+alternation + medians cancel it.  ``single_core_caveat`` is stamped in
+the JSON line: every wall ratio here is a plumbing number (all threads
+share one core); the transferable evidence is the gap histogram.
+Honors ``FAA_BENCH_REQUIRE_QUIET=1`` (refuses on a contended host,
+exit 3).
 
     python tools/bench_pipeline.py --num-search 32 --trial-batch 4
     make bench-pipeline
@@ -69,6 +76,15 @@ def _copy_fold_ckpt(src_dir: str, dst_dir: str, name: str) -> None:
             shutil.copy2(src, os.path.join(dst_dir, name + suffix))
 
 
+def _median(xs):
+    xs = sorted(x for x in xs if x is not None)
+    n = len(xs)
+    if n == 0:
+        return None
+    mid = n // 2
+    return xs[mid] if n % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+
 def run_pipeline_bench(args, workdir: str) -> dict:
     import jax
 
@@ -88,8 +104,8 @@ def run_pipeline_bench(args, workdir: str) -> dict:
     devices = jax.device_count()
 
     # warmup: train the shared phase-1 fold + fill the compile cache
-    # (one round of trials compiles the TTA step into the cache, so
-    # neither measured arm's first dispatch is a compile window)
+    # (one round of trials compiles the TTA step into the cache, so no
+    # measured round's first dispatch is a compile window)
     warm_dir = os.path.join(workdir, "warm")
     search_policies(conf, save_dir=warm_dir,
                     num_search=max(1, args.trial_batch), **common)
@@ -112,24 +128,53 @@ def run_pipeline_bench(args, workdir: str) -> dict:
         finally:
             os.environ.pop("FAA_PIPELINE_TRACE", None)
         pipe = result.get("pipeline") or {}
+        gaps = pipe.get("dispatch_gaps") or {}
         return {
             "mode": "async" if async_on else "serial",
-            "actors": args.actors if async_on else None,
-            "queue_depth": args.queue_depth if async_on else None,
             "search_secs": round(wall, 3),
             "phase2_secs": round(
                 result["device_secs_phase2"] / max(1, devices), 3),
             "device_busy_frac": pipe.get("device_busy_frac"),
-            "dispatch_gaps": pipe.get("dispatch_gaps"),
+            "gap_p50_ms": gaps.get("gap_p50_ms"),
+            "gap_p99_ms": gaps.get("gap_p99_ms"),
+            "gap_total_secs": gaps.get("gap_total_secs"),
+            "num_gaps": gaps.get("num_gaps"),
+            "num_dispatches": gaps.get("num_dispatches"),
             "tell_reorders": pipe.get("tell_reorders"),
             "num_sub_policies": result.get("num_sub_policies"),
-            "compile_cache": result.get("compile_cache"),
         }
 
-    serial = _one_arm("serial", False)
-    async_ = _one_arm("async", True)
-    speedup = (serial["phase2_secs"] / async_["phase2_secs"]
-               if async_["phase2_secs"] else None)
+    # paired alternating arm order + per-arm medians: the 1-core A/B
+    # discipline (bench_router.py) — fixed-order arms read ±2-3%
+    # allocator drift as signal on this host
+    rounds: list[dict] = []
+    for i in range(max(1, args.pairs)):
+        order = (("serial", "async") if i % 2 == 0
+                 else ("async", "serial"))
+        for name in order:
+            rounds.append(_one_arm(f"{name}{i}", name == "async"))
+
+    arms = {}
+    for name in ("serial", "async"):
+        rows = [r for r in rounds if r["mode"] == name]
+        arms[name] = {
+            "rounds": len(rows),
+            "phase2_secs_median": _median([r["phase2_secs"] for r in rows]),
+            "search_secs_median": _median([r["search_secs"] for r in rows]),
+            "device_busy_frac_median": _median(
+                [r["device_busy_frac"] for r in rows]),
+            "gap_p50_ms_median": _median([r["gap_p50_ms"] for r in rows]),
+            "gap_p99_ms_median": _median([r["gap_p99_ms"] for r in rows]),
+            "gap_total_secs_median": _median(
+                [r["gap_total_secs"] for r in rows]),
+            "num_dispatches": rows[-1]["num_dispatches"],
+            "tell_reorders_total": sum(r["tell_reorders"] or 0
+                                       for r in rows),
+        }
+    arms["async"].update(actors=args.actors, queue_depth=args.queue_depth)
+    s_med = arms["serial"]["phase2_secs_median"]
+    a_med = arms["async"]["phase2_secs_median"]
+    speedup = (s_med / a_med) if (s_med and a_med) else None
     return {
         "bench": "pipeline",
         "devices": devices,
@@ -137,9 +182,15 @@ def run_pipeline_bench(args, workdir: str) -> dict:
         "trial_batch": args.trial_batch,
         "num_policy": args.num_policy,
         "num_op": args.num_op,
-        "serial": serial,
-        "async": async_,
+        "pairs": args.pairs,
+        "serial": arms["serial"],
+        "async": arms["async"],
+        "rounds": rounds,
         "phase2_speedup": round(speedup, 3) if speedup else None,
+        # every process here shares ONE core: wall ratios measure
+        # scheduling plumbing, not device overlap — the transferable
+        # evidence is the gap histogram (docs/BENCHMARKS.md)
+        "single_core_caveat": True,
     }
 
 
@@ -153,6 +204,9 @@ def main(argv=None):
     p.add_argument("--cv-ratio", type=float, default=0.4)
     p.add_argument("--actors", type=int, default=1)
     p.add_argument("--queue-depth", type=int, default=1)
+    p.add_argument("--pairs", type=int, default=2,
+                   help="paired alternating (serial,async) rounds; "
+                        "per-arm medians reported")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--workdir", default=None,
                    help="scratch dir (default: a fresh tempdir, removed "
@@ -184,15 +238,17 @@ def main(argv=None):
 
     for arm in ("serial", "async"):
         a = record[arm]
-        gaps = a["dispatch_gaps"] or {}
-        print(f"{arm}: phase2 {a['phase2_secs']}s, busy_frac "
-              f"{a['device_busy_frac']}, gap p50 {gaps.get('gap_p50_ms')}ms "
-              f"p99 {gaps.get('gap_p99_ms')}ms over {gaps.get('num_gaps')} "
-              f"gaps ({gaps.get('num_dispatches')} dispatches)")
-    print(f"phase2_speedup: {record['phase2_speedup']}x")
-    busy = record["async"]["device_busy_frac"] or 0.0
+        print(f"{arm} (medians over {a['rounds']} alternating rounds): "
+              f"phase2 {a['phase2_secs_median']}s, busy_frac "
+              f"{a['device_busy_frac_median']}, gap p50 "
+              f"{a['gap_p50_ms_median']}ms p99 {a['gap_p99_ms_median']}ms "
+              f"({a['num_dispatches']} dispatches/round)")
+    print(f"phase2_speedup (median/median): {record['phase2_speedup']}x "
+          "[single_core_caveat: wall on this host is plumbing, the gap "
+          "histogram is the evidence]")
+    busy = record["async"]["device_busy_frac_median"] or 0.0
     ok = busy >= 0.9 or (record["phase2_speedup"] or 0.0) >= 1.5
-    print("acceptance (busy_frac >= 0.9 during phase 2 OR >= 1.5x "
+    print("acceptance (median busy_frac >= 0.9 during phase 2 OR >= 1.5x "
           f"phase-2 speedup): {'PASS' if ok else 'FAIL'}")
 
     line = json.dumps(record)

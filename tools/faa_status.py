@@ -17,6 +17,14 @@ Aggregates, across every host that writes under ``--dir``:
   --heartbeat-dir``): alive / done / STALE verdicts against ``--ttl``;
 - **done markers** (``done/<unit>.json``): units finished per host and
   the reclaimed-unit evidence (``attempt > 1``);
+- **the fleet-search topology** (docs/RESILIENCE.md "Fleet search"):
+  per-host role (learner/actor, from role-stamped host beats and the
+  journaled ``round`` events), round units currently claimed (live
+  leases), in-flight window occupancy (published rounds with no posted
+  result), and the cross-host lane-concurrency evidence — seconds a
+  phase-1 training lane on one host overlapped phase-2 TTA lanes on
+  DIFFERENT hosts (the transferable multi-host win a 1-core container
+  cannot show as wall);
 - **the serving plane** (docs/SERVING.md): replica census from
   ``--port-dir`` discovery records (+ heartbeats and same-host pid
   probes), in/out-of-rotation verdicts from the router's journaled
@@ -133,6 +141,139 @@ def read_done_markers(root: str) -> list[dict]:
             if rec:
                 out.append(rec)
     return out
+
+
+def read_leases(root: str) -> dict[str, dict]:
+    """Live lease records by unit (``leases/<unit>.json``) — the
+    claimed-unit view of the workqueue/fleet-search protocols."""
+    out: dict[str, dict] = {}
+    leases_dir = os.path.join(root, "leases")
+    try:
+        names = sorted(os.listdir(leases_dir))
+    except OSError:
+        return out
+    for name in names:
+        if name.endswith(".json"):
+            rec = _read_json(os.path.join(leases_dir, name))
+            if rec and rec.get("unit"):
+                out[str(rec["unit"])] = rec
+    return out
+
+
+def _phase_windows(journal: list[dict], lane: str) -> dict[str, list]:
+    """Per-host wall-aligned ``(t0, t1)`` windows from ``phase`` events
+    of one lane.  Monotonic spans align onto the wall clock through
+    each record's own (t_wall - t_mono) emit offset — the same
+    alignment trick as the trace export, good to the emit jitter."""
+    out: dict[str, list] = {}
+    for r in journal:
+        if r.get("type") != "phase" or r.get("lane") != lane:
+            continue
+        vals = (r.get("t_mono_start"), r.get("t_mono_end"),
+                r.get("t_wall"), r.get("t_mono"))
+        if not all(isinstance(v, (int, float)) for v in vals):
+            continue
+        t0, t1, tw, tm = (float(v) for v in vals)
+        off = tw - tm
+        out.setdefault(str(r.get("host")), []).append((t0 + off, t1 + off))
+    return out
+
+
+def _windows_overlap_secs(a: list, b: list) -> float:
+    return sum(max(0.0, min(e0, e1) - max(s0, s1))
+               for s0, e0 in a for s1, e1 in b)
+
+
+_ROUND_ACTIONS = {"publish": "published", "claim": "claimed",
+                  "return": "returned", "apply": "applied"}
+
+
+def search_fleet_status(root: str, journal: list[dict],
+                        beats: dict[str, dict]) -> dict | None:
+    """The fleet-search topology section: per-host role (learner/actor
+    from host beats, falling back to what the ``round`` events prove),
+    per-host round counts, round units currently claimed (live
+    leases), the in-flight window occupancy (published rounds with no
+    posted result), and the cross-host lane-concurrency evidence —
+    seconds during which a phase-1 lane on one host overlapped a
+    phase-2 lane on a DIFFERENT host (the ROADMAP acceptance surface:
+    the wall win the 1-core container cannot show).  None when the dir
+    shows no fleet search at all."""
+    hosts: dict[str, dict] = {}
+
+    def _row(host: str) -> dict:
+        return hosts.setdefault(host, {
+            "role": None, "published": 0, "claimed": 0, "returned": 0,
+            "applied": 0})
+
+    for r in journal:
+        if r.get("type") != "round":
+            continue
+        key = _ROUND_ACTIONS.get(r.get("action"))
+        if key:
+            _row(str(r.get("host")))[key] += 1
+    for owner, rec in beats.items():
+        if rec.get("role"):
+            _row(str(owner))["role"] = rec["role"]
+    for row in hosts.values():
+        if row["role"] is None:  # infer from the journal evidence
+            if row["published"] or row["applied"]:
+                row["role"] = "learner"
+            elif row["claimed"] or row["returned"]:
+                row["role"] = "actor"
+
+    leases = read_leases(root)
+    claimed_rounds: dict[str, list[str]] = {}
+    for unit, rec in leases.items():
+        if unit.startswith("p2r-"):
+            claimed_rounds.setdefault(str(rec.get("owner")),
+                                      []).append(unit)
+    for owner, units in claimed_rounds.items():
+        _row(owner).setdefault("role", None)
+        hosts[owner]["claimed_units"] = sorted(units)
+
+    # in-flight window occupancy: published rounds with no result yet
+    open_rounds: list[str] = []
+    work_dir = os.path.join(root, "work")
+    done_dir = os.path.join(root, "done")
+    try:
+        names = sorted(os.listdir(work_dir))
+    except OSError:
+        names = []
+    for name in names:
+        if name.endswith(".json") and name.startswith("p2r-"):
+            unit = name[:-5]
+            if not os.path.exists(os.path.join(done_dir, name)):
+                open_rounds.append(unit)
+
+    # cross-host lane concurrency: phase-1 training on host A while
+    # phase-2 TTA on host B (actors emit per-round phase2 windows)
+    p1 = _phase_windows(journal, "phase1")
+    p2 = _phase_windows(journal, "phase2")
+    lane_pairs = []
+    total_overlap = 0.0
+    for h1, w1 in p1.items():
+        for h2, w2 in p2.items():
+            if h1 == h2:
+                continue
+            ov = _windows_overlap_secs(w1, w2)
+            if ov > 0:
+                lane_pairs.append({"phase1_host": h1, "phase2_host": h2,
+                                   "overlap_secs": round(ov, 3)})
+                total_overlap += ov
+    lane_pairs.sort(key=lambda p: -p["overlap_secs"])
+
+    if not hosts and not open_rounds:
+        return None
+    return {
+        "hosts": {k: hosts[k] for k in sorted(hosts)},
+        "open_rounds": open_rounds,
+        "inflight_rounds": len(open_rounds),
+        "concurrent_lane_pairs": lane_pairs,
+        "concurrent_lane_secs": round(total_overlap, 3),
+        "search_done": os.path.exists(
+            os.path.join(root, "search_done.json")),
+    }
 
 
 def read_port_records(port_dir: str) -> list[dict]:
@@ -315,6 +456,9 @@ def fleet_status(root: str, ttl: float = 60.0,
                                    port_dir=port_dir, ttl=ttl, now=now)
     if serving is not None:
         out["serving"] = serving
+    search_fleet = search_fleet_status(root, journal, beats)
+    if search_fleet is not None:
+        out["search_fleet"] = search_fleet
     return out
 
 
@@ -351,6 +495,36 @@ def render_table(status: dict) -> str:
         tail += (f"\n  reclaimed: {rec['unit']} attempt {rec['attempt']} "
                  f"finished by {rec['finished_by']} "
                  f"(from {rec['reclaimed_from']})")
+    fleet_search = status.get("search_fleet")
+    if fleet_search:
+        tail += "\n\nfleet search:"
+        for name, row in sorted(fleet_search["hosts"].items()):
+            counts = (f"published={row['published']} "
+                      f"claimed={row['claimed']} "
+                      f"returned={row['returned']} "
+                      f"applied={row['applied']}")
+            tail += (f"\n  {name}: role={row.get('role') or '?'}  "
+                     f"{counts}")
+            units = row.get("claimed_units")
+            if units:
+                tail += f"  holding [{', '.join(units)}]"
+        tail += (f"\n  in-flight window: {fleet_search['inflight_rounds']} "
+                 "open round(s)")
+        if fleet_search["open_rounds"]:
+            tail += f" [{', '.join(fleet_search['open_rounds'][:8])}" + \
+                    ("...]" if len(fleet_search["open_rounds"]) > 8 else "]")
+        if fleet_search["search_done"]:
+            tail += "  (search done)"
+        pairs = fleet_search["concurrent_lane_pairs"]
+        if pairs:
+            tail += (f"\n  concurrent lanes (distinct hosts): "
+                     f"{fleet_search['concurrent_lane_secs']}s total")
+            for pr in pairs[:6]:
+                tail += (f"\n    phase1@{pr['phase1_host']} || "
+                         f"phase2@{pr['phase2_host']}: "
+                         f"{pr['overlap_secs']}s")
+        else:
+            tail += "\n  concurrent lanes (distinct hosts): none observed"
     serving = status.get("serving")
     if serving:
         tail += "\n\nserving plane:"
@@ -400,9 +574,10 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     status = fleet_status(args.dir, ttl=args.ttl, port_dir=args.port_dir)
-    if not status["hosts"] and not status.get("serving"):
+    if not status["hosts"] and not status.get("serving") \
+            and not status.get("search_fleet"):
         print(f"faa_status: nothing under {args.dir} (no journal-*.jsonl, "
-              "no hosts/*.json, no serving-plane records)",
+              "no hosts/*.json, no serving-plane or fleet-search records)",
               file=sys.stderr)
         return 2
     if args.json:
