@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-compile bench-pipeline bench-fleet-search trace status clean reproduce
+.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-compile bench-pipeline bench-fleet-search bench-control trace status clean reproduce
 
 # telemetry journal dir for the trace/status targets (override:
 #   make trace TELEMETRY=/shared/run TRACE_OUT=overlap.json)
@@ -108,6 +108,15 @@ bench-pipeline:
 # Honors FAA_BENCH_REQUIRE_QUIET=1 (refuses on a contended host).
 bench-fleet-search:
 	python tools/bench_fleet_search.py
+
+# control-plane bench: a real 3-replica --traffic-stats fleet with a
+# drill-mode control_cli — injected drift (FAA_FAULT drift@...) ->
+# detect -> canary -> promote mid-traffic vs a steady arm, as paired
+# alternating rounds with medians; reports shift->detect and
+# detect->promote latency, rollover goodput and the zero-drop verdict
+# (docs/CONTROL.md "Measuring the loop")
+bench-control:
+	python tools/bench_control.py
 
 # render a --telemetry journal dir as a Chrome trace (open the output
 # in chrome://tracing or ui.perfetto.dev): per-thread dispatch spans,

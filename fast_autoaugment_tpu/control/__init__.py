@@ -1,0 +1,46 @@
+"""Closed-loop control plane: drift detection, warm-started re-search,
+canary rollout, gated promotion (docs/CONTROL.md).
+
+Host-only orchestration over the seams the earlier subsystems built —
+the telemetry journal (PR 10), the ``replay_trial_log`` TPE ledger
+(PR 9), ``POST /reload`` (PR 8) and the digest-affinity router
+(PR 12).  Nothing here touches a device; the loop decides WHEN to
+search and WHAT to serve."""
+
+from fast_autoaugment_tpu.control.canary import (
+    CanaryController,
+    PromotionGate,
+    ReplicaQualityScraper,
+    compare_arms,
+    select_canary_replicas,
+)
+from fast_autoaugment_tpu.control.drift import (
+    CusumMeanShift,
+    DriftMonitor,
+    TrafficSampleReader,
+)
+from fast_autoaugment_tpu.control.loop import ControlLoop
+from fast_autoaugment_tpu.control.research import (
+    load_provenance,
+    policy_file_digest,
+    provenance_path,
+    warm_started_research,
+    write_provenance,
+)
+
+__all__ = [
+    "CanaryController",
+    "ControlLoop",
+    "CusumMeanShift",
+    "DriftMonitor",
+    "PromotionGate",
+    "ReplicaQualityScraper",
+    "TrafficSampleReader",
+    "compare_arms",
+    "load_provenance",
+    "policy_file_digest",
+    "provenance_path",
+    "select_canary_replicas",
+    "warm_started_research",
+    "write_provenance",
+]

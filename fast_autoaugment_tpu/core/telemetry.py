@@ -120,6 +120,14 @@ EVENT_TYPES = frozenset({
     "tenant",         # multi-policy tenancy admit/evict/warm (serve LRU)
     "scale_up",       # autoscaler grew the replica fleet (evidence inline)
     "scale_down",     # autoscaler shrank the replica fleet
+    # closed-loop control plane (control/, docs/CONTROL.md): the four
+    # stage transitions of the drift->promote loop, each carrying its
+    # metric evidence inline exactly like the autoscaler's decisions
+    "drift",          # a seeded statistical test tripped on served traffic
+    "research",       # a warm-started top-up search produced a candidate
+    "canary",         # canary rollout start/verify on a replica subset
+    "promote",        # the delta gate promoted the candidate fleet-wide
+    "rollback",       # the delta gate rolled the canary subset back
 })
 
 

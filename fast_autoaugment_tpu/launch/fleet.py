@@ -506,8 +506,11 @@ def main(argv=None):
                         "worker CLIs pick it up without extra flags "
                         "(core/compilecache.py)")
     p.add_argument("--roles", default=None, metavar="R1,R2,...",
-                   help="fleet-search role per host (learner/actor), "
-                        "exported as FAA_SEARCH_ROLE to every launch "
+                   help="per-host fleet role (learner/actor for a "
+                        "--fleet-transport search; control for a "
+                        "control_cli host riding a --no-rank-args "
+                        "serving fleet), exported as FAA_SEARCH_ROLE "
+                        "to every launch "
                         "AND retry so search_cli --search-role auto "
                         "resolves it.  One role broadcasts to all "
                         "hosts; otherwise the list must match the host "

@@ -118,6 +118,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-policy", type=int, default=5)
     p.add_argument("--num-op", type=int, default=2)
     p.add_argument("--num-search", type=int, default=200)
+    p.add_argument("--topup-trials", type=int, default=0,
+                   help="warm-started incremental RE-SEARCH (the control "
+                        "plane's entry point, docs/CONTROL.md): extend a "
+                        "completed --save-dir's per-fold trial budget by "
+                        "this many trials.  Resume replays the persisted "
+                        "trial log — --async-pipeline on routes it "
+                        "through the PR-9 replay_trial_log ledger, so "
+                        "the TPE continues exactly where the original "
+                        "run left off — and only the top-up trials "
+                        "dispatch; search_result.json stamps "
+                        "'warm_start'.  0 (default) = the historical "
+                        "budget, artifact stream untouched")
     p.add_argument("--num-top", type=int, default=10)
     p.add_argument("--async-pipeline", default="off", choices=("off", "on"),
                    help="streaming actor/learner phase-2 scheduler "
@@ -520,6 +532,7 @@ def _run(args, conf, t_start):
         pipeline_queue_depth=args.pipeline_queue_depth,
         telemetry_spec=args.telemetry,
         fleet_transport=transport,
+        topup_trials=args.topup_trials,
     )
     final_policy_set = result["final_policy_set"]
     random_policy_set = result.get("random_policy_set") or []

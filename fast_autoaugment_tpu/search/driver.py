@@ -539,6 +539,7 @@ def search_policies(
     pipeline_queue_depth: int = 1,
     telemetry_spec: str = "off",
     fleet_transport=None,
+    topup_trials: int = 0,
 ) -> SearchResult:
     """Run phases 1 and 2; returns the final policy set plus accounting.
 
@@ -701,6 +702,15 @@ def search_policies(
     ``search_result.json['compile_cache']`` (``core/compilecache.py``;
     "off" still honors an inherited ``FAA_COMPILE_CACHE``).
 
+    `topup_trials` (0 default) is the WARM-START entry point the
+    control plane's incremental re-search uses (``control/research.py``,
+    docs/CONTROL.md): a completed search's per-fold budget extends by
+    this many trials — resume replays the persisted trial log (through
+    the PR-9 ``replay_trial_log`` ledger under ``async_pipeline``), only
+    the top-up dispatches, and the artifact stamps ``warm_start``.  A
+    top-up of 0 is a plain resume: `final_policy.json` reproduces the
+    one-shot run byte-identically.
+
     PHASE ordering stays sequential (VERDICT round 1, next-step 9):
     phase-1 fold training and phase-2 TTA evaluation are both
     device-bound on the same chip, so overlapping PHASES cannot shorten
@@ -714,6 +724,20 @@ def search_policies(
     """
     if smoke_test:  # reference --smoke-test (search.py:153, 235)
         num_search = 4
+
+    # warm-started incremental re-search (the control plane's entry
+    # point, control/research.py + docs/CONTROL.md): `topup_trials` > 0
+    # EXTENDS a completed search's per-fold trial budget by that many
+    # trials — resume replays the persisted trial log (the async
+    # pipeline routes it through the PR-9 replay_trial_log ledger, so
+    # the TPE's RNG stream sits exactly where the original run left
+    # it), then only the top-up trials dispatch.  0 (default) leaves
+    # the historical budget — and the artifact stream — untouched;
+    # topup with an EMPTY save_dir is just a longer fresh search.
+    topup_trials = max(0, int(topup_trials))
+    if topup_trials:
+        base_num_search = num_search
+        num_search += topup_trials
 
     # persistent compile cache (core/compilecache.py): "off" (default,
     # bit-for-bit historical) still honors an inherited
@@ -775,6 +799,16 @@ def search_policies(
 
     trial_batch = max(1, int(trial_batch))
     result["trial_batch"] = trial_batch
+    if topup_trials:
+        # stamped ONLY on warm-started runs: a default run's artifact
+        # carries no new keys (the defaults-bit-for-bit contract)
+        result["warm_start"] = {
+            "base_num_search": base_num_search,
+            "topup_trials": topup_trials,
+            "num_search": num_search,
+            "resumed_trials_per_fold": {
+                str(f): len(_load_fold_trials(f)) for f in fold_list},
+        }
     wd = resolve_watchdog(watchdog)
     # async actor/learner pipeline (search/pipeline.py): resolved here
     # so a typo fails loudly before any training; the dispatch trace is

@@ -673,7 +673,8 @@ class PolicyServer:
                  breaker_threshold: int = 0,
                  breaker_cooldown_s: float = 5.0,
                  dispatch_timeout_s: float = 0.0,
-                 tenant_capacity: int = 0):
+                 tenant_capacity: int = 0,
+                 traffic_stats: bool = False):
         self.applier = applier
         self.max_batch = int(max_batch or applier.max_batch)
         if self.max_batch > applier.max_batch:
@@ -757,6 +758,25 @@ class PolicyServer:
         self.dispatch_walls: list[float] = []
         self._dispatch_attempts = 0  # incl. fast-fails + injected errors
         self._wall_ema: float | None = None
+        # served-traffic statistics (control/drift.py's signal source,
+        # docs/CONTROL.md): per-dispatch input moments + a reward proxy
+        # (mean normalized |out - in| — the augmentation-effect
+        # magnitude), published as gauges and stamped onto the journal's
+        # serve dispatch events.  OFF by default: the historical journal
+        # stream and /stats surface are byte-identical without the flag.
+        self.traffic_stats = bool(traffic_stats)
+        self._traffic_ema: dict[str, float | None] = {
+            "input_mean": None, "input_std": None, "reward_proxy": None}
+        self._traffic_samples = 0
+        self._traffic_gauges = None
+        if self.traffic_stats:
+            self._traffic_gauges = {
+                name: reg.gauge(
+                    f"faa_serve_{name}",
+                    "served-traffic statistic (EMA over dispatches; "
+                    "the drift monitor / canary comparator signal)",
+                    server=self._server_id)
+                for name in ("input_mean", "input_std", "reward_proxy")}
 
     # ------------------------------------------------------- lifecycle
 
@@ -948,16 +968,22 @@ class PolicyServer:
         image/channels, the SAME dispatch mode (a request's key shape
         depends on it), and a ``max_batch`` covering the server's."""
         self._validate_applier(new_applier, verb="reload")
+        digest = getattr(new_applier, "digest", None)
         with self._lock:
             self.applier = new_applier
-            self.default_digest = getattr(new_applier, "digest", None)
+            self.default_digest = digest
             self._ctr["reloads"].inc()
             n = self.reloads
         telemetry.emit("reload", f"serve{self._server_id}", reloads=n,
-                       num_sub=new_applier.num_sub)
-        logger.info("hot reload #%d: applier swapped (%d sub-policies)",
-                    n, new_applier.num_sub)
-        return {"reloads": n, "num_sub": new_applier.num_sub}
+                       num_sub=new_applier.num_sub, digest=digest)
+        logger.info("hot reload #%d: applier swapped (%d sub-policies, "
+                    "digest %s)", n, new_applier.num_sub, digest)
+        # the digest echo is the canary comparator's verification that
+        # the intended policy is actually resident (docs/CONTROL.md) —
+        # a reload that succeeds without saying WHAT is now serving
+        # cannot be audited
+        return {"reloads": n, "num_sub": new_applier.num_sub,
+                "digest": digest}
 
     # ---------------------------------------------------------- tenancy
 
@@ -1124,6 +1150,44 @@ class PolicyServer:
             return None
         return plan.serve_fault(self._dispatch_attempts)
 
+    def _injected_drift(self, images: np.ndarray) -> np.ndarray:
+        """The ``drift@dispatch=N,shift=S`` seam: from the matching
+        dispatch onward every input batch is pixel-shifted by S before
+        statistics and device work — the deterministic distribution
+        shift the control plane's acceptance drill detects
+        (utils/faultinject.py, docs/CONTROL.md)."""
+        from fast_autoaugment_tpu.utils.faultinject import active_plan
+
+        plan = active_plan()
+        if plan is None:
+            return images
+        # the counter was already bumped for this dispatch by _dispatch
+        shift = plan.drift_shift(self._dispatch_attempts)
+        if shift is None:
+            return images
+        return np.clip(images.astype(np.float32, copy=False) + shift,
+                       0.0, 255.0)
+
+    def _observe_traffic(self, images: np.ndarray,
+                         out: np.ndarray) -> dict:
+        """Update the served-traffic EMAs/gauges from one dispatched
+        batch and return the journal fields for its dispatch event.
+        Host-side numpy over an already-materialized batch (~µs at
+        serving batch sizes); only runs with ``traffic_stats`` on."""
+        m = float(np.mean(images))
+        s = float(np.std(images))
+        proxy = float(np.mean(np.abs(
+            np.asarray(out, np.float32) - images))) / 255.0
+        for name, v in (("input_mean", m), ("input_std", s),
+                        ("reward_proxy", proxy)):
+            prev = self._traffic_ema[name]
+            ema = v if prev is None else 0.2 * v + 0.8 * prev
+            self._traffic_ema[name] = ema
+            self._traffic_gauges[name].set(ema)
+        self._traffic_samples += 1
+        return {"input_mean": round(m, 4), "input_std": round(s, 4),
+                "reward_proxy": round(proxy, 6)}
+
     def _dispatch(self, batch: list[_Pending]) -> None:
         # ONE applier per dispatch (the reload AND tenancy seam): the
         # binding is taken once here and holds a strong reference, so a
@@ -1156,6 +1220,7 @@ class PolicyServer:
             self._fail_batch(batch, err)
             return
         images = np.concatenate([p.images for p in batch])
+        images = self._injected_drift(images)
         if applier.dispatch == "exact":
             keys = np.concatenate([p.keys for p in batch])
         else:
@@ -1212,11 +1277,15 @@ class PolicyServer:
         with self._lock:
             self.batch_sizes.append(images.shape[0])
             self.dispatch_walls.append(wall)
+        # served-traffic statistics ride the dispatch event (the drift
+        # monitor's journal-derived signal); OFF = no new journal keys
+        traffic = (self._observe_traffic(images, out)
+                   if self.traffic_stats else {})
         # the serve arm of the span seam: same record shape as the
         # trainer/TTA dispatch windows (core/telemetry.py)
         telemetry.record_dispatch("serve_dispatch", t0, done,
                                   batch=int(images.shape[0]),
-                                  requests=len(batch))
+                                  requests=len(batch), **traffic)
         self._wall_ema = (wall if self._wall_ema is None
                           else 0.2 * wall + 0.8 * self._wall_ema)
 
@@ -1329,6 +1398,14 @@ class PolicyServer:
             "draining": self._closed.is_set(),
         }
         out["default_digest"] = self.default_digest
+        # the explicit resident-policy identity (the canary comparator
+        # reads this name; default_digest stays as the PR-12 alias)
+        out["policy_digest"] = self.default_digest
+        if self.traffic_stats:
+            out["traffic"] = {
+                "samples": self._traffic_samples,
+                **{k: (None if v is None else round(v, 6))
+                   for k, v in self._traffic_ema.items()}}
         if self._tenants is not None:
             out["tenancy"] = self._tenants.snapshot()
         if sizes:

@@ -203,6 +203,14 @@ class Router:
         self._rr = 0                 # round-robin cursor (digest-less)
         self._poll_round = 0
         self._requests_routed = 0    # the replica_down fault coordinate
+        # canary split (control plane, docs/CONTROL.md): while armed,
+        # requests for the canary digest steer to the canary subset,
+        # requests for any OTHER digest steer away from it (those
+        # replicas no longer hold the old policy), and digest-less
+        # traffic splits deterministically — every `every`-th request
+        # lands on the canary arm.  None = historical routing.
+        self._canary: dict | None = None
+        self._canary_count = 0
         self._stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
         # static replicas are membership by CONFIGURATION: discovery
@@ -226,6 +234,11 @@ class Router:
         self._failover_ctr = reg.counter(
             "faa_router_failovers_total",
             "upstream attempts beyond the first", router=self.name)
+        self._canary_ctr = {a: reg.counter(
+            "faa_router_canary_requests_total",
+            "requests landing on the canary vs baseline arm while a "
+            "canary split is armed", arm=a, router=self.name)
+            for a in ("canary", "baseline")}
         self._rotation_gauge = reg.gauge(
             "faa_router_replicas", "replicas currently in rotation",
             state="in_rotation", router=self.name)
@@ -386,6 +399,61 @@ class Router:
             # shutdown — the poller is a daemon either way
             self._poll_thread.join(timeout=timeout)
 
+    # ---------------------------------------------------- canary split
+
+    def set_canary(self, digest: str, tags: list[str],
+                   every: int = 2) -> dict:
+        """Arm the canary split: `tags` are the replicas serving the
+        candidate policy `digest`; every `every`-th digest-less request
+        routes to them (a deterministic 1/every traffic share), canary-
+        digest requests prefer them, other-digest requests avoid them.
+        Re-arming replaces the previous split."""
+        if not tags:
+            raise ValueError("canary split needs at least one replica tag")
+        with self._lock:
+            self._canary = {"digest": str(digest),
+                            "tags": set(str(t) for t in tags),
+                            "every": max(1, int(every))}
+            self._canary_count = 0
+            snap = dict(self._canary, tags=sorted(self._canary["tags"]))
+        telemetry.emit("canary", self.name, action="split_set",
+                       digest=snap["digest"], replicas=snap["tags"],
+                       every=snap["every"])
+        logger.info("router: canary split armed: digest=%s replicas=%s "
+                    "every=%d", snap["digest"], snap["tags"],
+                    snap["every"])
+        return snap
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            was = self._canary
+            self._canary = None
+        if was is not None:
+            telemetry.emit("canary", self.name, action="split_cleared",
+                           digest=was["digest"])
+            logger.info("router: canary split cleared (digest=%s)",
+                        was["digest"])
+
+    def _canary_partition_locked(self, ordered: list,
+                                 digest: str | None) -> list:
+        """Reorder `ordered` (rendezvous/RR order) per the armed canary
+        split; within each arm the incoming order is preserved so
+        failover stays deterministic."""
+        can = self._canary
+        tags = can["tags"]
+        on_canary = [r for r in ordered if r.tag in tags]
+        off_canary = [r for r in ordered if r.tag not in tags]
+        if not on_canary or not off_canary:
+            return ordered  # the split degenerated: nothing to steer
+        if digest is not None:
+            return (on_canary + off_canary
+                    if digest == can["digest"]
+                    else off_canary + on_canary)
+        self._canary_count += 1
+        if self._canary_count % can["every"] == 0:
+            return on_canary + off_canary
+        return off_canary + on_canary
+
     # --------------------------------------------------------- routing
 
     def candidates(self, digest: str | None) -> tuple[list[Replica], str | None]:
@@ -412,6 +480,8 @@ class Router:
                 live.sort(key=lambda r: r.tag)
                 self._rr = (self._rr + 1) % len(live)
                 ordered = live[self._rr:] + live[:self._rr]
+            if self._canary is not None:
+                ordered = self._canary_partition_locked(ordered, digest)
             primary_tag = ordered[0].tag
             ready = [r for r in ordered if r.backoff_until <= now]
             cooling = [r for r in ordered if r.backoff_until > now]
@@ -485,6 +555,11 @@ class Router:
     def _count_routed(self, tag: str, primary_tag: str, attempt: int) -> None:
         self._req_ctr["ok" if attempt == 0 else "failover_ok"].inc()
         self._affinity_ctr["hit" if tag == primary_tag else "miss"].inc()
+        with self._lock:
+            can = self._canary
+        if can is not None:
+            self._canary_ctr["canary" if tag in can["tags"]
+                             else "baseline"].inc()
         telemetry.registry().counter(
             "faa_router_upstream_requests_total",
             "requests served per upstream replica",
@@ -497,6 +572,9 @@ class Router:
             reps = {t: r.snapshot() for t, r in self._replicas.items()}
             routed = self._requests_routed
             poll_round = self._poll_round
+            canary = (None if self._canary is None
+                      else dict(self._canary,
+                                tags=sorted(self._canary["tags"])))
         hits = int(self._affinity_ctr["hit"].value)
         misses = int(self._affinity_ctr["miss"].value)
         total = hits + misses
@@ -519,6 +597,11 @@ class Router:
             },
             "outcomes": {o: int(c.value)
                          for o, c in self._req_ctr.items()},
+            "canary": canary and {
+                **canary,
+                "routed": {a: int(c.value)
+                           for a, c in self._canary_ctr.items()},
+            },
         }
 
 

@@ -13,8 +13,14 @@ rendezvous-hashes to (``serve/router.py``):
   the replica backing off per its ``Retry-After`` and fail over
   (bounded by ``--failover-attempts``); with no replica in rotation
   the router itself answers a structured 503.
+- ``POST /canary`` — the control plane's canary-split admin
+  (``{"digest": D, "replicas": [tags], "every": N}`` arms it,
+  ``{"clear": true}`` clears it): canary-digest traffic steers to the
+  subset, other-digest traffic away from it, digest-less traffic
+  splits 1/N deterministically (docs/CONTROL.md).
 - ``GET /stats`` — router topology: replica census with in/out-of-
-  rotation verdicts, affinity hit rate, failover/outcome counters.
+  rotation verdicts, affinity hit rate, failover/outcome counters,
+  the armed canary split (arm landing counts included).
 - ``GET /healthz`` — router liveness (200 while the process runs).
 - ``GET /readyz`` — 200 only while >= 1 replica is in rotation.
 - ``GET /metrics`` — Prometheus exposition of the process registry
@@ -93,8 +99,34 @@ def make_router_handler(router: Router,
             self._send_json(404, {"error": f"unknown path {self.path}",
                                   "type": "unknown_path"})
 
+        def _do_canary(self):
+            """``POST /canary`` — the control plane's split admin
+            (docs/CONTROL.md): body ``{"digest": D, "replicas": [tags],
+            "every": N}`` arms the split, ``{"clear": true}`` clears
+            it.  Answers the router's canary stats block."""
+            try:
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                req = json.loads(self.rfile.read(length) or b"{}") \
+                    if length > 0 else {}
+                if not isinstance(req, dict):
+                    raise ValueError("canary body must be a JSON object")
+                if req.get("clear"):
+                    router.clear_canary()
+                else:
+                    router.set_canary(str(req["digest"]),
+                                      list(req["replicas"]),
+                                      every=int(req.get("every", 2)))
+            except (KeyError, TypeError, ValueError) as e:
+                self._send_json(400, {"error": f"{type(e).__name__}: {e}",
+                                      "type": "bad_request"})
+                return
+            self._send_json(200, {"canary": router.stats()["canary"]})
+
         def do_POST(self):
             try:
+                if self.path == "/canary":
+                    self._do_canary()
+                    return
                 if self.path != "/augment":
                     self._send_json(404,
                                     {"error": f"unknown path {self.path}",

@@ -110,6 +110,22 @@ def build_policy_tensor(spec: str) -> np.ndarray:
     return np.asarray(policy_to_tensor(load_policy(spec)), np.float32)
 
 
+def load_policy_provenance(spec: str) -> dict | None:
+    """The provenance sidecar for a policy file, or None.
+
+    ``<stem>.provenance.json`` next to ``<stem>.json`` (the control
+    plane's re-search writes it — ``control/research.py`` owns the
+    schema); archive names and policies without a sidecar simply have
+    no provenance.  Unreadable sidecars read as None — serving must
+    never fail on provenance bookkeeping."""
+    if not spec or not str(spec).endswith(".json") \
+            or not os.path.exists(spec):
+        return None
+    from fast_autoaugment_tpu.control.research import load_provenance
+
+    return load_provenance(spec)
+
+
 def _seed_keys(seeds) -> np.ndarray:
     """Per-image seeds -> [n, 2] uint32 PRNG keys (one PRNGKey per
     seed — the reproducible-serving contract)."""
@@ -131,6 +147,12 @@ class ServeState:
         self.server = server
         self.policy_spec = policy_spec
         self.build_applier = build_applier  # policy tensor -> applier
+        # provenance sidecar of the resident policy (written by the
+        # control plane's warm-started re-search next to the policy
+        # JSON — control/research.py): /stats and the /reload response
+        # carry it so the canary comparator can verify WHICH policy
+        # generation is actually answering (docs/CONTROL.md)
+        self.provenance = load_policy_provenance(policy_spec)
         self.httpd = None
         self.exit_code = 0
         self.stop_event = threading.Event()
@@ -173,7 +195,12 @@ class ServeState:
             policy = build_policy_tensor(spec)
             applier = self.build_applier(policy)
             info = self.server.swap_applier(applier)
-            info.update(policy=spec,
+            # info already echoes the resident digest (swap_applier);
+            # attach the policy's provenance sidecar so the caller can
+            # verify which GENERATION is now serving, not just which
+            # bytes
+            self.provenance = load_policy_provenance(spec)
+            info.update(policy=spec, provenance=self.provenance,
                         warm_sec=round(mono() - t0, 3))
             logger.info("reload complete: %s", info)
             return info
@@ -357,6 +384,12 @@ def make_handler(server, applier, state: ServeState | None = None,
                 stats["aot_compile"] = {
                     str(s): r for s, r in getattr(
                         server.applier, "compile_log", {}).items()}
+                # resident-policy identity + provenance: the canary
+                # comparator's check that THIS replica answers with the
+                # generation it was told to serve (docs/CONTROL.md)
+                stats["policy_provenance"] = (state.provenance
+                                              if state is not None
+                                              else None)
                 self._send_json(200, stats)
                 return
             if self.path == "/metrics":
@@ -740,6 +773,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "BACKGROUND warm; the 503 answer carries "
                         "warming=true so clients/routers retry once "
                         "resident")
+    # ---------------- closed-loop control plane (defaults off = the
+    # historical journal/stats stream byte-identical) ------------------
+    p.add_argument("--traffic-stats", action="store_true",
+                   help="publish served-traffic statistics: per-dispatch "
+                        "input moments + a reward proxy (mean normalized "
+                        "|out-in|) as faa_serve_{input_mean,input_std,"
+                        "reward_proxy} gauges, /stats 'traffic', and "
+                        "fields on the journal's serve dispatch events — "
+                        "the drift monitor / canary comparator signal "
+                        "(control/, docs/CONTROL.md)")
     return p
 
 
@@ -778,7 +821,8 @@ def main(argv=None):
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown,
         dispatch_timeout_s=args.dispatch_timeout,
-        tenant_capacity=args.tenant_capacity).start()
+        tenant_capacity=args.tenant_capacity,
+        traffic_stats=args.traffic_stats).start()
     state = ServeState(server, args.policy, build_applier,
                        policy_dir=args.policy_dir)
     cc = compile_cache_stats()

@@ -79,6 +79,17 @@ Kinds and their trigger coordinates:
     where ``((round-1)//P) % 2 == 1``) — the flapping-backend case the
     rotation hysteresis must ride through (eject on repeated failure,
     re-enter on recovery, never oscillate per-poll).
+``drift@dispatch=N,shift=S``
+    Injected distribution shift at the policy server's dispatch seam:
+    from the N-th coalesced dispatch (1-based attempt counter) ONWARD,
+    every input batch's pixel values are shifted by S (clipped to
+    [0, 255]) before statistics and device work — a deterministic,
+    replayable stand-in for drifting live traffic.  LATCHES like
+    ``stale_lease`` (drifted traffic stays drifted), accepts the
+    ``attempt=N`` gate, and drives the control plane's acceptance
+    drill: the drift monitor's CUSUM trips on the shifted input
+    moments without any real traffic change (``control/drift.py``,
+    docs/CONTROL.md).
 
 Each step/save/trial-pinned spec fires exactly ONCE per process (the
 counter-based kinds are consumed when hit); ``io_error`` fires per its
@@ -125,12 +136,13 @@ _KINDS = {
     "serve_slow": ("dispatch", "factor", "attempt"),
     "replica_down": ("request", "attempt"),
     "readyz_flap": ("period", "attempt"),
+    "drift": ("dispatch", "shift", "attempt"),
 }
 
 # keys that are optional for their kind (everything else is required)
 _OPTIONAL_KEYS = {"attempt"}
 # value parsers: default int
-_FLOAT_KEYS = {"p", "factor"}
+_FLOAT_KEYS = {"p", "factor", "shift"}
 _STR_KEYS = {"unit"}
 
 #: env var carrying the per-host launch counter (fleet exports it on
@@ -299,6 +311,26 @@ class FaultPlan:
         f = self._take("serve_slow", "dispatch", dispatch_n)
         if f is not None:
             return ("slow", float(f["factor"]))
+        return None
+
+    def drift_shift(self, dispatch_n: int) -> float | None:
+        """The active injected distribution shift for the policy
+        server's `dispatch_n`-th coalesced dispatch, or None.  LATCHES
+        from the first matching dispatch onward (drifted traffic stays
+        drifted); honors the ``attempt=N`` gate."""
+        for f in self.faults:
+            if f["kind"] != "drift":
+                continue
+            if "attempt" in f and current_attempt() != f["attempt"]:
+                continue
+            if dispatch_n < f["dispatch"]:
+                continue
+            if not f["fired"]:
+                f["fired"] = True
+                logger.warning(
+                    "faultinject: input distribution SHIFTED by %+g from "
+                    "dispatch %d onward (drift)", f["shift"], dispatch_n)
+            return float(f["shift"])
         return None
 
     def replica_down_now(self, request_n: int) -> bool:

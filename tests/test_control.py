@@ -1,0 +1,885 @@
+"""Closed-loop control plane (ISSUE 14, fast_autoaugment_tpu/control/):
+CUSUM drift detection over journal-derived traffic statistics, the
+FAA_FAULT drift verb, the served-traffic stats seam, reload digest /
+provenance echo, the router canary split, the promotion gate, the
+end-to-end loop state machine on stub transports, and the truncated
+trial-log warm-start byte-identity pins.
+
+All host-only / no-XLA-compile (tier-1 discipline); the live
+3-replica drill is tests/test_control_e2e.py (slow).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.core import telemetry as T
+from fast_autoaugment_tpu.control import (
+    CanaryController,
+    ControlLoop,
+    CusumMeanShift,
+    DriftMonitor,
+    PromotionGate,
+    TrafficSampleReader,
+    compare_arms,
+    load_provenance,
+    policy_file_digest,
+    provenance_path,
+    select_canary_replicas,
+    write_provenance,
+)
+from fast_autoaugment_tpu.control.research import seed_research_dir
+from fast_autoaugment_tpu.serve.policy_server import PolicyServer
+from fast_autoaugment_tpu.serve.router import Router
+from fast_autoaugment_tpu.utils import faultinject
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+IMG = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("FAA_TELEMETRY", raising=False)
+    monkeypatch.delenv("FAA_FAULT", raising=False)
+    monkeypatch.delenv("FAA_ATTEMPT", raising=False)
+    faultinject.reset()
+    # the registry is process-wide; loop/monitor counters share labels
+    # across tests (unlike PolicyServer's per-instance server ids)
+    T.registry()._reset_for_tests()
+    yield
+    T._disable_for_tests()
+    faultinject.reset()
+
+
+@pytest.fixture()
+def journal_dir(tmp_path):
+    d = str(tmp_path / "tel")
+    T.enable_telemetry(d, tb_bridge=False)
+    yield d
+    T._disable_for_tests()
+
+
+def _journal_records(directory):
+    T.journal_flush()
+    records = []
+    for path in sorted(glob.glob(
+            os.path.join(directory, "journal-*.jsonl"))):
+        with open(path) as fh:
+            records.extend(json.loads(ln) for ln in fh if ln.strip())
+    records.sort(key=lambda r: r["seq"])
+    return records
+
+
+class DummyApplier:
+    """Host-only applier: shifts pixels by `delta` (identifies WHICH
+    policy served a batch) and carries a digest like the AOT applier."""
+
+    def __init__(self, delta=1.0, digest=None):
+        self.delta = float(delta)
+        self.dispatch = "exact"
+        self.max_batch = 8
+        self.image = IMG
+        self.channels = 3
+        self.num_sub = 1
+        self.shapes = (8,)
+        self.digest = digest or f"dummy{delta:g}"
+
+    def apply(self, images, keys):
+        return np.asarray(images, np.float32) + self.delta
+
+
+def _images(n, value=100.0):
+    return np.full((n, IMG, IMG, 3), value, np.float32)
+
+
+def _keys(n):
+    return np.zeros((n, 2), np.uint32)
+
+
+# ------------------------------------------------- FAA_FAULT drift verb
+
+
+def test_drift_verb_parses_and_rejects():
+    fs = faultinject.parse_fault_spec("drift@dispatch=3,shift=40.5")
+    assert fs[0] == {"kind": "drift", "dispatch": 3, "shift": 40.5,
+                     "fired": False}
+    with pytest.raises(ValueError, match="missing"):
+        faultinject.parse_fault_spec("drift@dispatch=3")
+    with pytest.raises(ValueError, match="takes keys"):
+        faultinject.parse_fault_spec("drift@dispatch=3,shift=1,bogus=2")
+
+
+def test_drift_verb_latches_from_coordinate():
+    plan = faultinject.FaultPlan(
+        faultinject.parse_fault_spec("drift@dispatch=3,shift=40"))
+    assert plan.drift_shift(1) is None
+    assert plan.drift_shift(2) is None
+    assert plan.drift_shift(3) == 40.0
+    assert plan.drift_shift(2) is None  # below the coordinate: no fire
+    assert plan.drift_shift(9) == 40.0  # latched at/past it
+
+
+def test_drift_verb_attempt_gated(monkeypatch):
+    plan = faultinject.FaultPlan(faultinject.parse_fault_spec(
+        "drift@dispatch=1,shift=10,attempt=2"))
+    assert plan.drift_shift(5) is None  # attempt 1: gated off
+    monkeypatch.setenv("FAA_ATTEMPT", "2")
+    assert plan.drift_shift(5) == 10.0
+
+
+# ------------------------------------------------------------ the CUSUM
+
+
+def test_cusum_stationary_traffic_never_trips():
+    # default k/h: the slack absorbs in-band noise AND the frozen
+    # window's estimation error (drift.py docstring has the measured
+    # false-trip table behind these defaults)
+    for seed in range(5):
+        det = CusumMeanShift("m", baseline_n=20)
+        rng = np.random.default_rng(seed)
+        for v in 100.0 + rng.normal(0, 1.0, 1000):
+            assert det.update(float(v)) is None, seed
+        assert det.baselined
+
+
+def test_cusum_mean_shift_trips_deterministically():
+    def run():
+        det = CusumMeanShift("m", baseline_n=10, k=0.5, h=8.0)
+        rng = np.random.default_rng(1)
+        vals = list(100.0 + rng.normal(0, 1.0, 60))
+        vals += list(104.0 + rng.normal(0, 1.0, 60))  # the shift
+        for i, v in enumerate(vals):
+            ev = det.update(float(v))
+            if ev is not None:
+                return i, ev
+        raise AssertionError("shift never detected")
+
+    i1, ev1 = run()
+    i2, ev2 = run()
+    assert (i1, ev1) == (i2, ev2)  # seeded: same verdict, same sample
+    assert ev1["direction"] == "up" and i1 >= 60
+    assert ev1["stat"] > ev1["threshold"]
+    assert abs(ev1["baseline_mean"] - 100.0) < 1.5
+
+
+def test_cusum_detects_downward_shift_and_resets():
+    det = CusumMeanShift("m", baseline_n=5, k=0.5, h=4.0)
+    for _ in range(5):
+        det.update(50.0)
+    det.update(50.001)  # sigma floors at min_sigma; tiny jitter ok
+    ev = None
+    for _ in range(50):
+        ev = det.update(40.0)
+        if ev:
+            break
+    assert ev and ev["direction"] == "down"
+    det.reset()
+    assert not det.baselined and det.samples == 0
+
+
+# ------------------------------------------ journal reader + monitor
+
+
+def test_traffic_reader_tails_incrementally(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "journal-hostX-a1-p1.000.jsonl")
+
+    def rec(seq, mean):
+        return json.dumps({"type": "dispatch", "label": "serve_dispatch",
+                           "host": "hostX", "pid": 1, "seq": seq,
+                           "t_wall": float(seq), "t_mono": float(seq),
+                           "input_mean": mean, "reward_proxy": 0.1})
+
+    reader = TrafficSampleReader(d)
+    assert reader.poll() == []
+    with open(path, "w") as fh:
+        fh.write(rec(0, 100.0) + "\n" + rec(1, 101.0) + "\n")
+    assert [r["seq"] for r in reader.poll()] == [0, 1]
+    assert reader.poll() == []  # nothing new
+    # a torn tail is not consumed until its newline lands
+    with open(path, "a") as fh:
+        fh.write(rec(2, 102.0))
+    assert reader.poll() == []
+    with open(path, "a") as fh:
+        fh.write("\n")
+    assert [r["seq"] for r in reader.poll()] == [2]
+    # non-serve and field-less dispatch records are filtered out
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"type": "dispatch", "label": "train",
+                             "seq": 3, "input_mean": 1}) + "\n")
+        fh.write(json.dumps({"type": "dispatch",
+                             "label": "serve_dispatch", "seq": 4}) + "\n")
+    assert reader.poll() == []
+
+
+def test_drift_monitor_latches_and_rebaselines(journal_dir):
+    feed: list[list[dict]] = []
+    monitor = DriftMonitor(lambda: feed.pop(0) if feed else [],
+                           metrics=("input_mean",), baseline_n=5,
+                           cusum_k=0.5, cusum_h=4.0, name="drift-test")
+
+    def samples(vals):
+        return [{"input_mean": v, "host": "hostX", "seq": i}
+                for i, v in enumerate(vals)]
+
+    feed.append(samples([100.0, 101.0] * 5))
+    assert monitor.poll() is None
+    feed.append(samples([140.0] * 20))
+    verdict = monitor.poll()
+    assert verdict is not None and verdict["metric"] == "input_mean"
+    assert verdict["direction"] == "up"
+    assert monitor.latched
+    # latched: further drifted samples produce no NEW verdict
+    feed.append(samples([140.0] * 20))
+    assert monitor.poll() is None
+    # the verdict landed in the journal with its evidence inline
+    drift_events = [r for r in _journal_records(journal_dir)
+                    if r["type"] == "drift"]
+    assert len(drift_events) == 1
+    ev = drift_events[0]
+    assert ev["label"] == "drift-test" and ev["stat"] > ev["threshold"]
+    assert ev["baseline_mean"] is not None
+    # rebaseline: the new regime becomes normal, then a NEW shift trips
+    monitor.rebaseline()
+    assert not monitor.latched
+    feed.append(samples([140.0, 141.0] * 5))
+    assert monitor.poll() is None
+    feed.append(samples([100.0] * 20))
+    second = monitor.poll()
+    assert second is not None and second["direction"] == "down"
+    assert second["id"] != verdict["id"]
+
+
+# ------------------------------------- serve traffic stats + injection
+
+
+def test_traffic_stats_gauges_journal_and_stats(journal_dir):
+    srv = PolicyServer(DummyApplier(), max_wait_ms=1,
+                       traffic_stats=True).start()
+    try:
+        srv.augment(_images(4), _keys(4))
+        srv.augment(_images(4, value=200.0), _keys(4))
+    finally:
+        srv.stop()
+    st = srv.stats()
+    assert st["traffic"]["samples"] == 2
+    assert st["traffic"]["input_mean"] is not None
+    assert abs(st["traffic"]["reward_proxy"] - 1.0 / 255) < 1e-6
+    disp = [r for r in _journal_records(journal_dir)
+            if r["type"] == "dispatch" and r["label"] == "serve_dispatch"]
+    assert len(disp) == 2
+    assert disp[0]["input_mean"] == 100.0
+    assert disp[1]["input_mean"] == 200.0
+    assert all("reward_proxy" in d and "input_std" in d for d in disp)
+    # the gauges are scrape-visible (the canary comparator's surface)
+    text = T.registry().prometheus_text()
+    assert f'faa_serve_reward_proxy{{server="{srv._server_id}"}}' in text
+
+
+def test_traffic_stats_off_is_historical_stream(journal_dir):
+    srv = PolicyServer(DummyApplier(), max_wait_ms=1).start()
+    try:
+        srv.augment(_images(4), _keys(4))
+    finally:
+        srv.stop()
+    assert "traffic" not in srv.stats()
+    disp = [r for r in _journal_records(journal_dir)
+            if r["type"] == "dispatch" and r["label"] == "serve_dispatch"]
+    assert disp and all("input_mean" not in d for d in disp)
+    snap = T.registry().snapshot()["gauges"]
+    assert not any(k.startswith("faa_serve_input_mean")
+                   and f'server="{srv._server_id}"' in k for k in snap)
+
+
+def test_drift_injection_shifts_inputs_and_stats(monkeypatch):
+    monkeypatch.setenv("FAA_FAULT", "drift@dispatch=2,shift=50")
+    faultinject.reset()
+    srv = PolicyServer(DummyApplier(delta=0.0), max_wait_ms=1,
+                       traffic_stats=True).start()
+    try:
+        out1 = srv.augment(_images(2), _keys(2))
+        out2 = srv.augment(_images(2), _keys(2))
+        out3 = srv.augment(_images(2), _keys(2))
+    finally:
+        srv.stop()
+    # dispatch 1 unshifted; dispatches 2+ shifted (latched)
+    assert float(out1.mean()) == 100.0
+    assert float(out2.mean()) == 150.0
+    assert float(out3.mean()) == 150.0
+
+
+def test_reload_echoes_digest_and_journal(journal_dir):
+    srv = PolicyServer(DummyApplier(digest="aaa111"), max_wait_ms=1).start()
+    try:
+        info = srv.swap_applier(DummyApplier(2.0, digest="bbb222"))
+    finally:
+        srv.stop()
+    assert info["digest"] == "bbb222"
+    assert srv.stats()["policy_digest"] == "bbb222"
+    rel = [r for r in _journal_records(journal_dir)
+           if r["type"] == "reload"]
+    assert rel and rel[-1]["digest"] == "bbb222"
+
+
+# -------------------------------------------- provenance sidecar
+
+
+def test_provenance_roundtrip_and_digest(tmp_path):
+    policy = [[["Rotate", 0.5, 0.4], ["Invert", 0.2, 0.0]]]
+    ppath = str(tmp_path / "final_policy.json")
+    with open(ppath, "w") as fh:
+        json.dump(policy, fh)
+    assert load_provenance(ppath) is None
+    side = write_provenance(ppath, {"kind": "test", "topup_trials": 7})
+    assert side == provenance_path(ppath)
+    assert side.endswith("final_policy.provenance.json")
+    prov = load_provenance(ppath)
+    assert prov["kind"] == "test" and prov["topup_trials"] == 7
+    assert prov["schema_version"] == 1
+    # the sidecar digest IS the serving-plane digest of the bytes
+    from fast_autoaugment_tpu.policies.archive import policy_to_tensor
+    from fast_autoaugment_tpu.serve.policy_server import policy_digest
+
+    expect = policy_digest(policy_to_tensor(
+        [[(op, float(p), float(lv)) for op, p, lv in sub]
+         for sub in policy]))
+    assert prov["policy_digest"] == expect == policy_file_digest(ppath)
+    # serve_cli's loader resolves the same sidecar
+    from fast_autoaugment_tpu.serve.serve_cli import load_policy_provenance
+
+    assert load_policy_provenance(ppath)["policy_digest"] == expect
+    assert load_policy_provenance(str(tmp_path / "none.json")) is None
+
+
+def test_seed_research_dir_copies_substrate(tmp_path):
+    base = tmp_path / "base"
+    base.mkdir()
+    (base / "search_trials.json").write_text('{"0": []}')
+    (base / "wresnet_cifar10_fold0_ratio0.40.msgpack").write_text("ckpt")
+    (base / "audit.json").write_text("{}")
+    (base / "final_policy.json").write_text("[]")
+    (base / "search_result.json").write_text("{}")
+    (base / "journal-host0-a1-p1.000.jsonl").write_text("")
+    out = tmp_path / "cand"
+    copied = seed_research_dir(str(base), str(out))
+    assert "search_trials.json" in copied
+    assert "wresnet_cifar10_fold0_ratio0.40.msgpack" in copied
+    assert "audit.json" in copied
+    assert not (out / "final_policy.json").exists()
+    assert not (out / "search_result.json").exists()
+    assert not list(out.glob("journal-*"))
+    with pytest.raises(ValueError, match="unreadable base"):
+        seed_research_dir(str(out / "missing"), str(tmp_path / "x"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no trial log"):
+        seed_research_dir(str(empty), str(tmp_path / "y"))
+
+
+# ------------------------------------------------ canary selection/gate
+
+
+def test_select_canary_replicas_deterministic():
+    tags = ["replica0", "replica1", "replica2"]
+    a = select_canary_replicas("digest-a", tags, 1)
+    assert a == select_canary_replicas("digest-a", list(reversed(tags)), 1)
+    assert len(a) == 1 and a[0] in tags
+    # at least one replica always stays baseline
+    assert len(select_canary_replicas("digest-a", tags, 99)) == 2
+    with pytest.raises(ValueError, match=">= 2 replicas"):
+        select_canary_replicas("d", ["only"], 1)
+    # the subset is the rendezvous prefix for THIS digest
+    from fast_autoaugment_tpu.serve.router import rendezvous_order
+
+    assert select_canary_replicas("digest-b", tags, 2) == \
+        rendezvous_order("digest-b", sorted(tags))[:2]
+
+
+def test_compare_arms_math():
+    samples = {
+        "c0": {"reachable": True, "reward_proxy": 0.30,
+               "new_dispatches": 5, "new_breaker_fires": 0},
+        "b0": {"reachable": True, "reward_proxy": 0.10,
+               "new_dispatches": 6, "new_breaker_fires": 0},
+        "b1": {"reachable": True, "reward_proxy": 0.20,
+               "new_dispatches": 7, "new_breaker_fires": 1},
+        "dead": {"reachable": False},
+    }
+    ev = compare_arms(samples, ["c0"], target=0.25)
+    assert ev["canary"]["replicas"] == 1
+    assert ev["baseline"]["replicas"] == 2
+    assert abs(ev["canary"]["quality_distance"] - 0.05) < 1e-9
+    # baseline distances: |0.1-0.25|=0.15, |0.2-0.25|=0.05 -> median 0.1
+    assert abs(ev["baseline"]["quality_distance"] - 0.10) < 1e-9
+    assert abs(ev["quality_delta"] - (-0.05)) < 1e-9
+    assert ev["baseline"]["new_errors"] == 1
+    assert ev["canary"]["new_dispatches"] == 5
+
+
+def _evidence(delta, c_disp=5, b_disp=5, c_err=0):
+    return {"canary": {"quality_distance": 0.1 + delta,
+                       "new_dispatches": c_disp, "new_errors": c_err},
+            "baseline": {"quality_distance": 0.1,
+                         "new_dispatches": b_disp},
+            "quality_delta": delta}
+
+
+def test_gate_promotes_within_margin():
+    g = PromotionGate(gate_polls=3, quality_margin=0.05)
+    assert g.decide(_evidence(0.01))[0] is None
+    assert g.decide(_evidence(-0.02))[0] is None
+    action, reason, summary = g.decide(_evidence(0.03))
+    assert action == "promote"
+    assert summary["median_quality_delta"] == 0.01
+    assert "within margin" in reason
+
+
+def test_gate_rolls_back_on_quality_and_errors_and_starvation():
+    g = PromotionGate(gate_polls=2, quality_margin=0.05)
+    g.decide(_evidence(0.2))
+    action, reason, _ = g.decide(_evidence(0.3))
+    assert action == "rollback" and "exceeds margin" in reason
+    # new canary errors roll back IMMEDIATELY
+    g2 = PromotionGate(gate_polls=5, quality_margin=0.05)
+    action, reason, _ = g2.decide(_evidence(0.0, c_err=2))
+    assert action == "rollback" and "error" in reason
+    # traffic-starved polls never judge; the timeout rolls back
+    g3 = PromotionGate(gate_polls=2, quality_margin=0.05,
+                       timeout_polls=4)
+    for _ in range(3):
+        assert g3.decide(_evidence(0.0, c_disp=0))[0] is None
+    action, reason, _ = g3.decide(_evidence(0.0, c_disp=0))
+    assert action == "rollback" and "starved" in reason
+
+
+# ------------------------------------------------- router canary split
+
+
+def _static_router(n=3, **kw):
+    r = Router(static_replicas=[{"tag": f"replica{i}", "host": "h",
+                                 "port": 1000 + i} for i in range(n)],
+               **kw)
+    for rep in r._replicas.values():
+        rep.in_rotation = True
+    return r
+
+
+def test_router_canary_split_is_deterministic(journal_dir):
+    r = _static_router()
+    r.set_canary("digX", ["replica1"], every=3)
+    firsts = [r.candidates(None)[0][0].tag for _ in range(9)]
+    assert firsts.count("replica1") == 3  # exactly 1/3 of the traffic
+    # canary-digest traffic steers TO the canary; other digests AWAY
+    assert r.candidates("digX")[0][0].tag == "replica1"
+    for d in ("someother", "third"):
+        cands, _ = r.candidates(d)
+        assert cands[0].tag != "replica1"
+        assert cands[-1].tag == "replica1"  # still a last resort
+    st = r.stats()["canary"]
+    assert st["digest"] == "digX" and st["tags"] == ["replica1"]
+    evs = [x for x in _journal_records(journal_dir)
+           if x["type"] == "canary"]
+    assert [e["action"] for e in evs] == ["split_set"]
+    r.clear_canary()
+    assert r.stats()["canary"] is None
+    evs = [x for x in _journal_records(journal_dir)
+           if x["type"] == "canary"]
+    assert [e["action"] for e in evs] == ["split_set", "split_cleared"]
+
+
+def test_router_canary_counts_arms(monkeypatch):
+    r = _static_router()
+    r.set_canary("digX", ["replica0"], every=2)
+    monkeypatch.setattr(
+        r, "_upstream",
+        lambda rep, method, path, body, headers: (200, {}, b"ok"))
+    for _ in range(6):
+        status, _h, _b, routed = r.forward("POST", "/augment", b"x", {},
+                                           None)
+        assert status == 200
+    routed_counts = r.stats()["canary"]["routed"]
+    assert routed_counts["canary"] == 3
+    assert routed_counts["baseline"] == 3
+
+
+def test_router_cli_canary_admin_endpoint():
+    from fast_autoaugment_tpu.serve.router_cli import (
+        _RouterHTTPServer,
+        make_router_handler,
+    )
+    import http.client
+
+    r = _static_router()
+    httpd = _RouterHTTPServer(("127.0.0.1", 0), make_router_handler(r))
+    port = httpd.server_address[1]
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        def post(body):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            try:
+                conn.request("POST", "/canary",
+                             body=json.dumps(body).encode())
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+
+        status, out = post({"digest": "digZ", "replicas": ["replica2"],
+                            "every": 4})
+        assert status == 200 and out["canary"]["digest"] == "digZ"
+        assert r.stats()["canary"]["every"] == 4
+        status, out = post({"clear": True})
+        assert status == 200 and out["canary"] is None
+        status, out = post({"replicas": ["replica2"]})  # missing digest
+        assert status == 400
+        status, out = post({"digest": "d", "replicas": []})
+        assert status == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------- the loop state machine
+
+
+class _StubScraper:
+    """Feeds the loop scripted per-replica quality rows."""
+
+    def __init__(self, script):
+        self.script = script  # list of {tag: row}
+        self.calls = 0
+
+    def sample(self, replicas):
+        row = self.script[min(self.calls, len(self.script) - 1)]
+        self.calls += 1
+        return {str(r["tag"]): dict(row.get(str(r["tag"]),
+                                            {"reachable": False}))
+                for r in replicas}
+
+
+def _loop_fixture(journal_dir, tmp_path, scraper_script,
+                  research_exc=None):
+    """A ControlLoop over stub transports; returns (loop, calls)."""
+    policy = [[["Rotate", 0.5, 0.4], ["Invert", 0.2, 0.0]]]
+    base = str(tmp_path / "baseline.json")
+    cand = str(tmp_path / "candidate.json")
+    with open(base, "w") as fh:
+        json.dump(policy, fh)
+    with open(cand, "w") as fh:
+        json.dump([[["ShearX", 0.9, 0.1], ["Solarize", 0.3, 0.7]]], fh)
+    write_provenance(cand, {"kind": "test_candidate"})
+    cand_digest = policy_file_digest(cand)
+    base_digest = policy_file_digest(base)
+
+    calls = {"reloads": [], "router": []}
+
+    def reload_fn(host, port, policy_path):
+        calls["reloads"].append((host, port, policy_path))
+        return {"digest": policy_file_digest(policy_path)}
+
+    replicas = [{"tag": f"replica{i}", "host": "h", "port": 9000 + i}
+                for i in range(3)]
+    ctl = CanaryController(lambda: list(replicas), reload_fn=reload_fn)
+    ctl._router_canary = lambda payload: calls["router"].append(payload)
+
+    feed: list[list[dict]] = []
+
+    def research_fn(verdict):
+        if research_exc is not None:
+            raise research_exc
+        # the LOOP journals the research transition — stage fns stay
+        # transport-agnostic (pinned by the chain assertion below)
+        return {"policy": cand, "provenance": load_provenance(cand)}
+
+    monitor = DriftMonitor(lambda: feed.pop(0) if feed else [],
+                           metrics=("input_mean", "reward_proxy"),
+                           baseline_n=5, cusum_k=0.5, cusum_h=4.0)
+    loop = ControlLoop(
+        monitor, research_fn, ctl,
+        PromotionGate(gate_polls=2, quality_margin=0.05),
+        _StubScraper(scraper_script),
+        baseline_policy=base, baseline_digest=base_digest,
+        n_canary=1, split_every=2)
+    return loop, feed, calls, cand_digest, base_digest, cand, base
+
+
+def _drift_feed(feed):
+    def samples(vals):
+        return [{"input_mean": v, "reward_proxy": 0.1, "host": "h",
+                 "seq": i} for i, v in enumerate(vals)]
+
+    feed.append(samples([100.0, 101.0] * 4))
+    feed.append(samples([150.0] * 20))
+
+
+def test_control_loop_promotes_end_to_end(journal_dir, tmp_path):
+    canary_tag = select_canary_replicas(
+        policy_file_digest_of_candidate(tmp_path),
+        ["replica0", "replica1", "replica2"], 1)[0]
+    good = {t: {"reachable": True, "reward_proxy": 0.1,
+                "new_dispatches": 5, "new_breaker_fires": 0,
+                "dispatches": 5, "breaker_fires": 0}
+            for t in ("replica0", "replica1", "replica2")}
+    loop, feed, calls, cand_digest, base_digest, cand, base = \
+        _loop_fixture(journal_dir, tmp_path, [good])
+    assert loop.step() == "watching"
+    _drift_feed(feed)
+    assert loop.step() == "watching"   # baseline window
+    assert loop.step() == "research"   # verdict raised
+    assert loop.step() == "canary"     # candidate produced
+    assert loop.step() == "observing"  # rollout done, split armed
+    assert calls["router"][0]["digest"] == cand_digest
+    assert calls["router"][0]["replicas"] == [canary_tag]
+    # rollout reloaded exactly the canary subset with the candidate
+    assert [c[2] for c in calls["reloads"]] == [cand]
+    assert loop.step() == "observing"  # gate poll 1/2
+    assert loop.step() == "watching"   # gate poll 2/2 -> promote
+    # promote reloaded the candidate on the two baseline replicas
+    assert len(calls["reloads"]) == 3
+    assert all(c[2] == cand for c in calls["reloads"])
+    assert calls["router"][-1] == {"clear": True}
+    # the candidate is the new baseline; the monitor re-baselined
+    assert loop.baseline_digest == cand_digest
+    assert not loop.monitor.latched
+    assert loop.stats()["promotes"] == 1
+    # the journal carries the full causal chain in order
+    evs = [r for r in _journal_records(journal_dir)
+           if r["type"] in ("drift", "research", "canary", "promote")]
+    chain = [r["type"] for r in evs]
+    assert chain == ["drift", "research", "canary", "promote"]
+    promote = evs[-1]
+    assert promote["digest"] == cand_digest
+    assert promote["drift_id"] == evs[0]["id"]
+    assert promote["detect_to_promote_sec"] >= 0
+    assert promote["evidence"]["median_quality_delta"] is not None
+
+
+def policy_file_digest_of_candidate(tmp_path):
+    cand = str(tmp_path / "candidate.json")
+    if not os.path.exists(cand):
+        with open(cand, "w") as fh:
+            json.dump([[["ShearX", 0.9, 0.1],
+                        ["Solarize", 0.3, 0.7]]], fh)
+    return policy_file_digest(cand)
+
+
+def test_control_loop_rolls_back_on_bad_quality(journal_dir, tmp_path):
+    cand_digest = policy_file_digest_of_candidate(tmp_path)
+    canary_tag = select_canary_replicas(
+        cand_digest, ["replica0", "replica1", "replica2"], 1)[0]
+    rows = {}
+    for t in ("replica0", "replica1", "replica2"):
+        # canary's proxy sits far from the pre-drift baseline target
+        proxy = 0.9 if t == canary_tag else 0.1
+        rows[t] = {"reachable": True, "reward_proxy": proxy,
+                   "new_dispatches": 5, "new_breaker_fires": 0,
+                   "dispatches": 5, "breaker_fires": 0}
+    loop, feed, calls, cand_digest, base_digest, cand, base = \
+        _loop_fixture(journal_dir, tmp_path, [rows])
+    _drift_feed(feed)
+    for expect in ("watching", "research", "canary", "observing",
+                   "observing"):
+        assert loop.step() == expect
+    assert loop.step() == "watching"  # gate filled -> rollback
+    # the canary replica was reloaded BACK to the baseline policy
+    assert calls["reloads"][-1][2] == base
+    assert calls["router"][-1] == {"clear": True}
+    assert loop.baseline_digest == base_digest  # unchanged
+    assert loop.stats()["rollbacks"] == 1
+    evs = [r["type"] for r in _journal_records(journal_dir)
+           if r["type"] in ("drift", "canary", "promote", "rollback")]
+    assert evs == ["drift", "canary", "rollback"]
+
+
+def test_control_loop_survives_research_failure(journal_dir, tmp_path):
+    loop, feed, calls, *_ = _loop_fixture(
+        journal_dir, tmp_path, [{}],
+        research_exc=RuntimeError("search exploded"))
+    _drift_feed(feed)
+    for expect in ("watching", "research"):
+        assert loop.step() == expect
+    assert loop.step() == "watching"  # failure -> back to watching
+    assert calls["reloads"] == []     # nothing actuated
+    marks = [r for r in _journal_records(journal_dir)
+             if r["type"] == "mark"
+             and r.get("event") == "research_failed"]
+    assert marks and "search exploded" in marks[0]["error"]
+    # the monitor stays latched: drift evidence is not forgotten just
+    # because one search attempt failed
+    assert loop.monitor.latched
+
+
+def test_reload_digest_mismatch_aborts_rollout(journal_dir, tmp_path):
+    good = {t: {"reachable": True, "reward_proxy": 0.1,
+                "new_dispatches": 5, "new_breaker_fires": 0}
+            for t in ("replica0", "replica1", "replica2")}
+    loop, feed, calls, *_ = _loop_fixture(journal_dir, tmp_path, [good])
+    loop.canary_ctl.reload_fn = \
+        lambda host, port, path: {"digest": "wrong!"}
+    _drift_feed(feed)
+    for expect in ("watching", "research", "canary"):
+        assert loop.step() == expect
+    # the rollout verification failed -> rollback path, loop survives
+    assert loop.step() == "watching"
+    assert loop.stats()["rollbacks"] == 1
+    marks = [r for r in _journal_records(journal_dir)
+             if r["type"] == "mark"
+             and r.get("event") == "rollout_failed"]
+    assert marks and "echoed digest" in marks[0]["error"]
+
+
+# ---------------------------------- truncated-log warm-start identity
+
+
+def _stub_pipeline_log(num_search, k, seed=11, fold_trials=None,
+                       max_inflight=1):
+    """Drive run_fold_pipeline with a deterministic host-only stub
+    evaluator (reward = policy-tensor sum mod 1) from an optional
+    resumed trial log; returns the trial log."""
+    import jax
+
+    from fast_autoaugment_tpu.search.driver import make_search_space
+    from fast_autoaugment_tpu.search.pipeline import (
+        replay_trial_log,
+        run_fold_pipeline,
+    )
+    from fast_autoaugment_tpu.search.tpe import TPE
+
+    class _Stub:
+        def evaluate(self, fold, params, batch_stats, policy_t, key):
+            raise AssertionError("batched-only stub")
+
+        def evaluate_batch(self, fold, params, batch_stats, policies_t,
+                           keys):
+            return [{"top1_valid": round(
+                float(np.asarray(policies_t[i]).sum()) % 1.0, 6)}
+                for i in range(int(policies_t.shape[0]))]
+
+    tpe = TPE(make_search_space(1, 1), seed=seed, n_startup=4)
+    fold_trials = list(fold_trials or [])
+    replay_trial_log(tpe, fold_trials, k, num_search,
+                     max_inflight=max_inflight)
+    run_fold_pipeline(
+        _Stub(), 0, None, None, tpe, jax.random.PRNGKey(0), fold_trials,
+        num_search=num_search, trial_batch=k, actors=1, queue_depth=0,
+        num_policy=1, num_op=1, persist=lambda: None,
+        record_quarantine=lambda lo, hi, exc, worst: None)
+    return fold_trials
+
+
+def test_warm_start_from_truncated_log_is_byte_identical():
+    """The satellite pin: a MID-ROUND truncated trial log replayed
+    through the ledger and continued produces the uninterrupted run's
+    log byte for byte (same JSON serialization)."""
+    full = _stub_pipeline_log(num_search=12, k=3)
+    assert len(full) == 12
+    for cut in (7, 5, 10):  # none on a round boundary of K=3
+        resumed = _stub_pipeline_log(num_search=12, k=3,
+                                     fold_trials=full[:cut])
+        assert json.dumps(resumed) == json.dumps(full), cut
+
+
+def test_warm_start_topup_extends_and_zero_topup_is_identity():
+    """Warm-start + top-up: the original budget's entries stay byte-
+    identical and exactly the top-up appends; a zero top-up dispatches
+    ZERO new trials."""
+    full = _stub_pipeline_log(num_search=12, k=3)
+    # zero new trials: the pipeline has nothing to dispatch
+    same = _stub_pipeline_log(num_search=12, k=3, fold_trials=full)
+    assert json.dumps(same) == json.dumps(full)
+    # top-up of 6: first 12 entries byte-identical, 6 new
+    topped = _stub_pipeline_log(num_search=18, k=3, fold_trials=full)
+    assert len(topped) == 18
+    assert json.dumps(topped[:12]) == json.dumps(full)
+    # and topping up from a TRUNCATED log still converges to the same
+    # 18-trial stream (replay + continue + extend in one pass)
+    topped2 = _stub_pipeline_log(num_search=18, k=3,
+                                 fold_trials=full[:7])
+    assert json.dumps(topped2) == json.dumps(topped)
+
+
+# --------------------------------------------- faa_status + CLI surface
+
+
+def test_faa_status_control_section(journal_dir):
+    T.emit("drift", "control", id="drift-1", metric="input_mean",
+           direction="up", stat=9.1, value=150.0, baseline_mean=100.0)
+    T.emit("research", "warm_start", candidate="/c/final_policy.json",
+           digest="abc", topup_trials=25, wall_sec=4.2)
+    T.emit("canary", "control", action="rollout", replica="replica2",
+           digest="abc")
+    T.emit("promote", "control", digest="abc", reason="within margin",
+           drift_id="drift-1", canary=["replica2"],
+           detect_to_promote_sec=3.21,
+           evidence={"median_quality_delta": -0.01,
+                     "quality_margin": 0.05})
+    T.journal_flush()
+    from faa_status import control_plane_status, fleet_status, render_table
+
+    status = fleet_status(journal_dir)
+    control = status["control"]
+    assert control["drift_verdicts"][0]["id"] == "drift-1"
+    assert control["researches"][0]["digest"] == "abc"
+    assert control["promotes"] == 1 and control["rollbacks"] == 0
+    assert control["last_decision"]["action"] == "promote"
+    assert control["last_decision"]["detect_to_promote_sec"] == 3.21
+    # the rollout precedes the decision -> no ACTIVE canary
+    assert control["active_canary"] is None
+    table = render_table(status)
+    assert "control plane:" in table
+    assert "drift drift-1" in table
+    assert "PROMOTE abc" in table
+    assert "detect->promote 3.21s" in table
+    # a rollout AFTER the decision is the active canary
+    T.emit("canary", "control", action="rollout", replica="replica0",
+           digest="def")
+    T.journal_flush()
+    control = control_plane_status(
+        __import__("faa_status").read_journal(journal_dir))
+    assert control["active_canary"][0]["digest"] == "def"
+
+
+def test_control_cli_parser_contract(tmp_path):
+    from fast_autoaugment_tpu.launch.control_cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--telemetry", "t", "--port-dir", "p",
+         "--baseline-policy", "b.json", "--candidate-policy", "c.json",
+         "--cusum-h", "4", "--gate-polls", "2"])
+    assert args.candidate_policy == "c.json"
+    assert args.cusum_h == 4.0
+    from fast_autoaugment_tpu.launch.control_cli import main
+
+    with pytest.raises(SystemExit):
+        main(["--telemetry", "t", "--port-dir", "p",
+              "--baseline-policy", "b.json"])  # no research seam
+    with pytest.raises(SystemExit):
+        main(["--telemetry", "t", "--port-dir", "p",
+              "--baseline-policy", "b.json",
+              "--research-cmd", "x", "--candidate-policy", "c.json"])
+
+
+def test_search_cli_topup_flag():
+    from fast_autoaugment_tpu.launch.search_cli import build_parser
+
+    args = build_parser().parse_args(
+        ["-c", "conf.yaml", "--topup-trials", "25"])
+    assert args.topup_trials == 25
+    assert build_parser().parse_args(["-c", "c.yaml"]).topup_trials == 0
+
+
+def test_event_taxonomy_has_control_types():
+    for etype in ("drift", "research", "canary", "promote", "rollback"):
+        assert etype in T.EVENT_TYPES

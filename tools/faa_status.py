@@ -400,6 +400,67 @@ def serving_plane_status(root: str, journal: list[dict],
     }
 
 
+def control_plane_status(journal: list[dict],
+                         drift_events: int = 5) -> dict | None:
+    """The control-plane section (docs/CONTROL.md): drift verdicts,
+    the active canary (rollouts newer than the last gate decision),
+    and the last promote/rollback with its evidence — all read from
+    the journal's typed drift/research/canary/promote/rollback
+    events.  None when the journal shows no control plane."""
+    drifts: list[dict] = []
+    researches: list[dict] = []
+    rollouts: list[dict] = []
+    decisions: list[dict] = []
+    for rec in journal:
+        etype = rec.get("type")
+        if etype == "drift":
+            drifts.append({
+                "id": rec.get("id"), "metric": rec.get("metric"),
+                "direction": rec.get("direction"),
+                "stat": rec.get("stat"), "value": rec.get("value"),
+                "baseline_mean": rec.get("baseline_mean"),
+                "t_wall": rec.get("t_wall")})
+        elif etype == "research":
+            researches.append({
+                "candidate": rec.get("candidate"),
+                "digest": rec.get("digest"),
+                "topup_trials": rec.get("topup_trials"),
+                "wall_sec": rec.get("wall_sec"),
+                "t_wall": rec.get("t_wall")})
+        elif etype == "canary" and rec.get("action") == "rollout":
+            rollouts.append({
+                "replica": rec.get("replica"),
+                "digest": rec.get("digest"),
+                "t_wall": rec.get("t_wall")})
+        elif etype in ("promote", "rollback"):
+            decisions.append({
+                "action": etype, "digest": rec.get("digest"),
+                "reason": rec.get("reason"),
+                "drift_id": rec.get("drift_id"),
+                "canary": rec.get("canary"),
+                "detect_to_promote_sec":
+                    rec.get("detect_to_promote_sec"),
+                "evidence": rec.get("evidence"),
+                "t_wall": rec.get("t_wall")})
+    if not (drifts or researches or rollouts or decisions):
+        return None
+    for seq in (drifts, researches, rollouts, decisions):
+        seq.sort(key=lambda e: e.get("t_wall") or 0)
+    last_decision = decisions[-1] if decisions else None
+    decided_at = (last_decision or {}).get("t_wall") or 0
+    active = [r for r in rollouts if (r.get("t_wall") or 0) > decided_at]
+    return {
+        "drift_verdicts": drifts[-max(0, int(drift_events)):],
+        "drift_verdict_total": len(drifts),
+        "researches": researches[-max(0, int(drift_events)):],
+        "active_canary": active or None,
+        "last_decision": last_decision,
+        "promotes": sum(1 for d in decisions if d["action"] == "promote"),
+        "rollbacks": sum(1 for d in decisions
+                         if d["action"] == "rollback"),
+    }
+
+
 def fleet_status(root: str, ttl: float = 60.0,
                  now: float | None = None,
                  port_dir: str | None = None) -> dict:
@@ -459,6 +520,9 @@ def fleet_status(root: str, ttl: float = 60.0,
     search_fleet = search_fleet_status(root, journal, beats)
     if search_fleet is not None:
         out["search_fleet"] = search_fleet
+    control = control_plane_status(journal)
+    if control is not None:
+        out["control"] = control
     return out
 
 
@@ -551,6 +615,41 @@ def render_table(status: dict) -> str:
                          f"queue={ev.get('queue_depth')}, "
                          f"shed_rate={ev.get('shed_rate')}, "
                          f"breaker={ev.get('breaker_open')})")
+    control = status.get("control")
+    if control:
+        tail += "\n\ncontrol plane:"
+        n_total = control.get("drift_verdict_total", 0)
+        for ev in control.get("drift_verdicts", []):
+            tail += (f"\n  drift {ev.get('id')}: {ev.get('metric')} "
+                     f"{ev.get('direction')} (stat={ev.get('stat')}, "
+                     f"value={ev.get('value')}, "
+                     f"baseline={ev.get('baseline_mean')})")
+        if n_total > len(control.get("drift_verdicts", [])):
+            tail += (f"\n  ({n_total} drift verdict(s) total)")
+        for ev in control.get("researches", []):
+            tail += (f"\n  research -> {ev.get('digest')} "
+                     f"(topup={ev.get('topup_trials')}, "
+                     f"{ev.get('wall_sec')}s)")
+        active = control.get("active_canary")
+        if active:
+            reps = sorted({str(r.get('replica')) for r in active})
+            tail += (f"\n  ACTIVE canary: {active[0].get('digest')} on "
+                     f"[{', '.join(reps)}]")
+        dec = control.get("last_decision")
+        if dec:
+            tail += (f"\n  last decision: {dec['action'].upper()} "
+                     f"{dec.get('digest')} ({dec.get('reason')})")
+            if dec.get("detect_to_promote_sec") is not None:
+                tail += (f"\n    detect->promote "
+                         f"{dec['detect_to_promote_sec']}s")
+            ev = dec.get("evidence") or {}
+            if ev.get("median_quality_delta") is not None:
+                tail += (f"; median quality delta "
+                         f"{ev['median_quality_delta']:+.6f} vs margin "
+                         f"{ev.get('quality_margin')}")
+        tail += (f"\n  decisions: {control.get('promotes', 0)} "
+                 f"promote(s), {control.get('rollbacks', 0)} "
+                 "rollback(s)")
     return "\n".join(lines) + "\n" + tail
 
 
@@ -575,7 +674,8 @@ def main(argv=None) -> int:
 
     status = fleet_status(args.dir, ttl=args.ttl, port_dir=args.port_dir)
     if not status["hosts"] and not status.get("serving") \
-            and not status.get("search_fleet"):
+            and not status.get("search_fleet") \
+            and not status.get("control"):
         print(f"faa_status: nothing under {args.dir} (no journal-*.jsonl, "
               "no hosts/*.json, no serving-plane or fleet-search records)",
               file=sys.stderr)
