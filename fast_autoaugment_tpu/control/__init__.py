@@ -20,6 +20,10 @@ from fast_autoaugment_tpu.control.drift import (
     TrafficSampleReader,
 )
 from fast_autoaugment_tpu.control.loop import ControlLoop
+from fast_autoaugment_tpu.control.resume import (
+    read_control_events,
+    reconstruct_inflight_episode,
+)
 from fast_autoaugment_tpu.control.research import (
     load_provenance,
     policy_file_digest,
@@ -40,6 +44,8 @@ __all__ = [
     "load_provenance",
     "policy_file_digest",
     "provenance_path",
+    "read_control_events",
+    "reconstruct_inflight_episode",
     "select_canary_replicas",
     "warm_started_research",
     "write_provenance",

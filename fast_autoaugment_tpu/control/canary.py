@@ -300,9 +300,11 @@ class CanaryController:
         finally:
             conn.close()
 
-    def _router_canary(self, payload: dict) -> None:
+    def _router_canary(self, payload: dict) -> dict | None:
+        """POST the split admin; returns the router's parsed echo
+        (``{"canary": {...}}``) or None when no router is configured."""
         if not self.router_url:
-            return
+            return None
         import http.client
         from urllib.parse import urlsplit
 
@@ -320,6 +322,10 @@ class CanaryController:
                 raise RuntimeError(
                     f"router canary admin answered {resp.status}: "
                     f"{data[:200]!r}")
+            try:
+                return json.loads(data)
+            except ValueError:
+                return None
         finally:
             conn.close()
 
@@ -363,6 +369,28 @@ class CanaryController:
                     mono() - t0)
         return {"canary": canary_tags, "baseline": baseline_tags,
                 "replicas": census}
+
+    def assert_split(self, expect_digest: str, canary_tags: list[str],
+                     split_every: int = 2) -> None:
+        """Idempotently RE-ASSERT the canary split (called on every
+        gate poll): ``POST /canary`` replaces any current split, so a
+        router that restarted mid-canary — which would otherwise route
+        100%% baseline while the gate kept scoring a phantom canary arm
+        — is re-armed within one poll.  The router's echo is verified;
+        a digest mismatch (another controller armed a DIFFERENT split)
+        raises rather than letting two control planes fight."""
+        echo = self._router_canary({"digest": expect_digest,
+                                    "replicas": list(canary_tags),
+                                    "every": int(split_every)})
+        if echo is None:
+            return  # no router configured: replica-count split only
+        armed = (echo.get("canary") or {})
+        if armed.get("digest") != expect_digest:
+            raise RuntimeError(
+                f"router canary echo mismatch: armed digest "
+                f"{armed.get('digest')!r} != expected {expect_digest!r} "
+                "— refusing to score a split this controller does not "
+                "own")
 
     def promote(self, policy_path: str, expect_digest: str,
                 census: dict, canary_tags: list[str]) -> None:

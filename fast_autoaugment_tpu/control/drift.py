@@ -38,12 +38,11 @@ the post-action traffic becomes the new baseline, which is what
 
 from __future__ import annotations
 
-import glob
 import json
 import math
 import os
 
-from fast_autoaugment_tpu.core import telemetry
+from fast_autoaugment_tpu.core import fsfault, telemetry
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = ["CusumMeanShift", "TrafficSampleReader", "DriftMonitor",
@@ -156,12 +155,16 @@ class TrafficSampleReader:
     ``serve_dispatch`` records carrying traffic-stat fields, in
     (host, pid, seq) order.
 
-    Per-file byte offsets make each :meth:`poll` cheap and exactly-once
-    over a growing journal; segment rotation shows up as new files
-    (old offsets for deleted segments are simply dropped).  Torn
-    trailing lines (a writer mid-flush) are retried on the next poll
-    by not advancing past them.  Read-only over shared files — the
-    same contract as ``tools/faa_status.py``."""
+    Per-file byte offsets make each :meth:`poll` cheap over a growing
+    journal; segment rotation shows up as new files (old offsets for
+    deleted segments are simply dropped).  Torn trailing lines (a
+    writer mid-flush) are retried on the next poll by not advancing
+    past them.  All file access goes through the ``core/fsfault.py``
+    seam, and exactly-once delivery is enforced by a per-(host, pid)
+    sequence-number WATERMARK rather than by trusting offsets alone —
+    a stale re-read or a shrink-then-grow file (the hostile-share
+    cases) can therefore never double-feed the CUSUM.  Read-only over
+    shared files — the same contract as ``tools/faa_status.py``."""
 
     def __init__(self, journal_dir: str, *, label: str = "serve_dispatch",
                  fields: tuple = DEFAULT_DRIFT_METRICS):
@@ -169,21 +172,23 @@ class TrafficSampleReader:
         self.label = str(label)
         self.fields = tuple(fields)
         self._offsets: dict[str, int] = {}
+        #: (host, pid) -> highest seq already delivered; re-reads of
+        #: already-seen records are dropped here (idempotent tailing)
+        self._watermarks: dict[tuple, int] = {}
 
     def _poll_file(self, path: str) -> list[dict]:
         out: list[dict] = []
         start = self._offsets.get(path, 0)
         try:
-            size = os.path.getsize(path)
+            size = fsfault.getsize(path)
             if size < start:
-                start = 0  # truncated/replaced file: start over
+                start = 0  # truncated/replaced (or stale re-read):
+                # start over — the seq watermark deduplicates
             if size == start:
                 return out
-            with open(path) as fh:
-                fh.seek(start)
-                data = fh.read()
+            data = fsfault.read_from(path, start)
         except OSError:
-            return out
+            return out  # transient (injected eio / half-visible file)
         # only consume COMPLETE lines; a torn tail stays unconsumed
         consumed = data.rfind("\n") + 1
         self._offsets[path] = start + len(data[:consumed].encode())
@@ -207,11 +212,36 @@ class TrafficSampleReader:
     def poll(self) -> list[dict]:
         pattern = os.path.join(self.journal_dir, "**", "journal-*.jsonl")
         records: list[dict] = []
-        for path in sorted(glob.glob(pattern, recursive=True)):
+        for path in fsfault.glob_files(pattern):
             records.extend(self._poll_file(path))
         records.sort(key=lambda r: (str(r.get("host")), r.get("pid", 0),
                                     r.get("seq", 0)))
-        return records
+        fresh: list[dict] = []
+        for rec in records:
+            key = (str(rec.get("host")), rec.get("pid", 0))
+            seq = rec.get("seq")
+            if isinstance(seq, int):
+                if seq <= self._watermarks.get(key, -1):
+                    continue  # re-read of an already-delivered record
+                self._watermarks[key] = seq
+            fresh.append(rec)
+        return fresh
+
+    def skip_to_end(self) -> int:
+        """Fast-forward every CURRENT journal segment to its end
+        without delivering the content: a resumed controller
+        (``control_cli --resume``) must judge post-resume traffic, not
+        replay the pre-crash episode's drifted history into a fresh
+        baseline.  Returns the number of files skipped."""
+        pattern = os.path.join(self.journal_dir, "**", "journal-*.jsonl")
+        n = 0
+        for path in fsfault.glob_files(pattern):
+            try:
+                self._offsets[path] = fsfault.getsize(path)
+                n += 1
+            except OSError:
+                continue
+        return n
 
 
 class DriftMonitor:

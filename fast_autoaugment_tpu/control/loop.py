@@ -77,6 +77,10 @@ class ControlLoop:
         self.name = str(name)
         self.state = "watching"
         self._episode: dict | None = None
+        #: a reconstructed episode handed in by resume(); adopted by
+        #: the NEXT step() so loop state stays single-writer (the
+        #: poll thread) — see control/resume.py
+        self._pending_resume: dict | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -173,6 +177,25 @@ class ControlLoop:
 
     def _run_observe(self) -> None:
         ep = self._episode
+        # RE-ASSERT the canary split every poll (idempotent POST
+        # /canary with echo verification): a router that restarted
+        # mid-canary silently routes 100% baseline while the gate
+        # keeps scoring a canary arm that no longer exists — one poll
+        # later the split is re-armed.  An echo mismatch (a split this
+        # controller does not own) rolls back instead of fighting.
+        try:
+            self.canary_ctl.assert_split(ep["digest"],
+                                         ep["arms"]["canary"],
+                                         self.split_every)
+        except Exception as e:  # noqa: BLE001 — journaled, loop survives
+            logger.error("canary split re-assert FAILED (%s: %s) — "
+                         "rolling the subset back", type(e).__name__, e)
+            telemetry.emit("mark", self.name, event="split_reassert_failed",
+                           error=f"{type(e).__name__}: {e}",
+                           digest=ep.get("digest"))
+            self._rollback(reason=f"canary split re-assert failed: {e}",
+                           evidence={})
+            return
         census = {str(r["tag"]): r for r in self.canary_ctl.replicas_fn()}
         samples = self.scraper.sample(list(census.values()))
         if "fallback_target" not in ep:
@@ -246,12 +269,65 @@ class ControlLoop:
         self._episode = None
         self.state = "watching"
 
+    # ---------------------------------------------------------- resume
+
+    def resume(self, episode: dict) -> str:
+        """Schedule a reconstructed in-flight episode for adoption
+        (``control_cli --resume`` after a controller crash —
+        ``control/resume.py`` rebuilt it from the journal WAL).  The
+        NEXT step() adopts it, so the poll thread stays the only
+        writer of loop state.
+
+        Stages re-enter IDEMPOTENTLY: a ``research``-stage episode
+        re-runs the re-search from the journaled verdict; a ``canary``/
+        ``observing``-stage episode re-enters at the ROLLOUT — every
+        reload is a digest-echoing re-verify and ``POST /canary``
+        replaces any dangling split, so replicas already holding the
+        candidate re-verify instantly, a router restarted baseline-only
+        re-arms, and the gate restarts its window on fresh traffic.  A
+        rollout that can no longer succeed rolls the subset back — a
+        SIGKILLed controller's dangling canary always terminates in a
+        journaled promote or rollback, never a forever-split."""
+        verdict = dict(episode.get("verdict") or {})
+        stage = ("canary" if episode.get("digest")
+                 and str(episode.get("stage")) in ("canary", "observing")
+                 else "research")
+        with self._lock:
+            self._pending_resume = dict(episode, verdict=verdict,
+                                        stage=stage)
+        telemetry.emit("mark", self.name, event="resume", stage=stage,
+                       drift_id=verdict.get("id"),
+                       digest=episode.get("digest"))
+        logger.warning("control loop RESUMING a dangling %s-stage "
+                       "episode (drift %s, candidate digest %s)",
+                       stage, verdict.get("id"), episode.get("digest"))
+        return stage
+
+    def _adopt_resume(self, pending: dict) -> None:
+        """Turn the scheduled episode into live loop state (poll
+        thread only)."""
+        self._episode_ctr.inc()
+        ep = {"verdict": pending["verdict"], "t_detect": mono()}
+        if pending["stage"] == "canary":
+            ep.update(candidate=pending["candidate"],
+                      digest=pending["digest"],
+                      provenance=pending.get("provenance") or {},
+                      t_candidate=mono())
+            self.state = "canary"
+        else:
+            self.state = "research"
+        self._episode = ep
+
     # ---------------------------------------------------------- driver
 
     def step(self) -> str:
         """One poll of whatever stage the loop is in; returns the
         state AFTER the step (the drill's observable)."""
         with self._lock:
+            if self._pending_resume is not None:
+                pending, self._pending_resume = self._pending_resume, None
+                self._adopt_resume(pending)
+                return self.state
             if self.state == "watching":
                 verdict = self.monitor.poll()
                 if verdict is not None:

@@ -21,11 +21,10 @@ answered (``control/canary.py``).
 
 from __future__ import annotations
 
-import json
 import os
 import shutil
 
-from fast_autoaugment_tpu.core import telemetry
+from fast_autoaugment_tpu.core import fsfault, telemetry
 from fast_autoaugment_tpu.core.telemetry import wall
 from fast_autoaugment_tpu.utils.logging import get_logger
 
@@ -55,8 +54,7 @@ def policy_file_digest(policy_path: str) -> str:
     from fast_autoaugment_tpu.policies.archive import policy_to_tensor
     from fast_autoaugment_tpu.serve.policy_server import policy_digest
 
-    with open(policy_path) as fh:
-        raw = json.load(fh)
+    raw = fsfault.load_json(policy_path)
     if not raw:
         raise ValueError(f"{policy_path} holds an empty policy set")
     subs = [[(str(op), float(p), float(lv)) for op, p, lv in sub]
@@ -81,14 +79,10 @@ def write_provenance(policy_path: str, stamp: dict) -> str:
 
 
 def _write_json_atomic(path: str, obj) -> None:
-    """The driver's fsync-then-rename idiom, host-only (importing
-    search.driver here would pull jax into a pure-bookkeeping path)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(obj, fh)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    """The fsync-then-rename idiom through the fsfault seam (host-only
+    — importing search.driver here would pull jax into a
+    pure-bookkeeping path)."""
+    fsfault.write_json_atomic(path, obj)
 
 
 def load_provenance(policy_path: str) -> dict | None:
@@ -97,13 +91,11 @@ def load_provenance(policy_path: str) -> dict | None:
     path = provenance_path(policy_path)
     if not os.path.exists(path):
         return None
-    try:
-        with open(path) as fh:
-            prov = json.load(fh)
-        return prov if isinstance(prov, dict) else None
-    except (OSError, ValueError) as e:
-        logger.warning("unreadable provenance sidecar %s: %s", path, e)
+    prov = fsfault.read_json(path)
+    if prov is None:
+        logger.warning("unreadable provenance sidecar %s", path)
         return None
+    return prov if isinstance(prov, dict) else None
 
 
 def seed_research_dir(base_dir: str, out_dir: str) -> list[str]:
@@ -115,7 +107,7 @@ def seed_research_dir(base_dir: str, out_dir: str) -> list[str]:
     os.makedirs(out_dir, exist_ok=True)
     copied: list[str] = []
     try:
-        names = sorted(os.listdir(base_dir))
+        names = fsfault.listdir(base_dir)
     except OSError as e:
         raise ValueError(f"unreadable base search dir {base_dir}: {e}")
     # everything resume reads comes along (trial logs, fold

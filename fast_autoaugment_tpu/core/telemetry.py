@@ -68,6 +68,7 @@ import re
 import threading
 import time
 
+from fast_autoaugment_tpu.core import fsfault
 from fast_autoaugment_tpu.utils.logging import get_logger
 
 __all__ = [
@@ -128,6 +129,7 @@ EVENT_TYPES = frozenset({
     "canary",         # canary rollout start/verify on a replica subset
     "promote",        # the delta gate promoted the candidate fleet-wide
     "rollback",       # the delta gate rolled the canary subset back
+    "fsfault",        # the FAA_FSFAULT seam injected a shared-FS fault
 })
 
 
@@ -139,8 +141,15 @@ EVENT_TYPES = frozenset({
 
 
 def wall() -> float:
-    """Wall-clock seconds (``time.time``) through the telemetry seam."""
-    return time.time()
+    """Wall-clock seconds (``time.time``) through the telemetry seam.
+
+    The ``FAA_FSFAULT skew@host=H,offset=±S`` verb lands HERE: a
+    matched host sees (and stamps) wall time offset by S seconds —
+    the deterministic stand-in for NTP drift across a fleet.  Unset
+    (the default), the consult is one cached None check."""
+    t = time.time()
+    plan = fsfault.active_plan()
+    return t + plan.wall_offset if plan is not None else t
 
 
 def mono() -> float:

@@ -118,6 +118,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fresh dispatches BOTH arms need per poll for "
                         "it to count as judgeable")
     # ---------------- process ----------------------------------------
+    p.add_argument("--resume", action="store_true",
+                   help="crash recovery: reconstruct a dangling "
+                        "episode from the journal WAL (a controller "
+                        "SIGKILLed mid-canary leaves the router split "
+                        "armed forever) and re-enter its stage "
+                        "idempotently — the episode terminates in a "
+                        "journaled promote or rollback.  Pre-crash "
+                        "traffic is skipped, never replayed into the "
+                        "fresh baseline")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--research-timeout", type=float, default=3600.0,
                    help="wall bound on one --research-cmd run (a wedged "
@@ -250,7 +259,29 @@ def main(argv=None) -> int:
         baseline_policy=args.baseline_policy,
         baseline_digest=policy_file_digest(args.baseline_policy),
         n_canary=args.canary_replicas, split_every=args.split_every,
-        poll_interval_s=args.poll_interval).start()
+        poll_interval_s=args.poll_interval)
+    if args.resume:
+        from fast_autoaugment_tpu.control.resume import (
+            read_control_events,
+            reconstruct_inflight_episode,
+        )
+
+        # never replay the pre-crash episode's drifted traffic into a
+        # fresh baseline — the WAL (not the sample stream) carries the
+        # in-flight state across the crash
+        skipped = reader.skip_to_end()
+        episode = reconstruct_inflight_episode(
+            read_control_events(args.telemetry))
+        if episode is not None:
+            logger.warning(
+                "--resume: dangling %s-stage episode reconstructed "
+                "from the journal (%d segment(s) fast-forwarded) — "
+                "re-entering", episode["stage"], skipped)
+            loop.resume(episode)
+        else:
+            logger.info("--resume: journal WAL is clean (%d segment(s) "
+                        "fast-forwarded) — watching", skipped)
+    loop.start()
     logger.info("control loop watching %s (replicas via %s, baseline "
                 "%s)", args.telemetry, args.port_dir,
                 loop.baseline_digest)

@@ -157,16 +157,14 @@ def _stream(prefix: str, pipe, out):
 def _heartbeat_age(workqueue_dir: str, host_tag: str) -> float | None:
     """Seconds since the worker's last host beat, None when unknown
     (no beat yet — e.g. still compiling — or unreadable mid-write) or
-    when the worker marked itself done (finished, not wedged)."""
-    import json
+    when the worker marked itself done (finished, not wedged).  The
+    supervisor and its workers share one machine (and one clock), so
+    this wall comparison is not a cross-host skew hazard."""
+    from fast_autoaugment_tpu.core import fsfault
 
     path = os.path.join(workqueue_dir, "hosts", f"{host_tag}.json")
-    try:
-        with open(path) as fh:
-            rec = json.load(fh)
-    except (OSError, ValueError):
-        return None
-    if rec.get("done"):
+    rec = fsfault.read_json(path)
+    if rec is None or rec.get("done"):
         return None
     try:
         return max(0.0, time.time() - float(rec["heartbeat"]))

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fast_autoaugment_tpu.core import telemetry
+from fast_autoaugment_tpu.core import fsfault, telemetry
 from fast_autoaugment_tpu.core.checkpoint import load_checkpoint, read_metadata
 from fast_autoaugment_tpu.core.compilecache import (
     compile_cache_stats,
@@ -780,8 +780,7 @@ def search_policies(
     trials_path = os.path.join(save_dir, "search_trials.json")
     trials_log: dict = {}
     if resume and os.path.exists(trials_path):
-        with open(trials_path) as fh:
-            trials_log = json.load(fh)
+        trials_log = fsfault.load_json(trials_path)
 
     def _fold_trials_path(fold: int) -> str:
         """Per-fold trial log (work-queue mode): one writer per lease,
@@ -790,8 +789,7 @@ def search_policies(
 
     def _load_fold_trials(fold: int) -> list:
         if work_queue is not None and os.path.exists(_fold_trials_path(fold)):
-            with open(_fold_trials_path(fold)) as fh:
-                return json.load(fh)
+            return fsfault.load_json(_fold_trials_path(fold))
         return trials_log.get(str(fold), [])
 
     def _fold_searched(fold: int) -> bool:
@@ -1082,12 +1080,15 @@ def search_policies(
                 work_queue.beat_host()
                 try:
                     info = run(fold, unit)
+                    # release() verifies the fencing token at post
+                    # time — a robbed host raises here instead of
+                    # clobbering the reclaimer's completion record
+                    work_queue.release(unit, info=info)
                 except LeaseLostError as e:
                     logger.warning(
                         "workqueue: lost the lease on %s mid-work (%s) — "
                         "abandoning it to its new owner", unit, e)
                     continue
-                work_queue.release(unit, info=info)
                 del pending[fold]
                 progress = True
             if pending and not progress:
@@ -1622,11 +1623,7 @@ def search_policies(
         apath = os.path.join(save_dir, cache_name)
         cached = None
         if resume and os.path.exists(apath):
-            try:
-                with open(apath) as fh:
-                    cached = json.load(fh)
-            except (OSError, ValueError):
-                cached = None
+            cached = fsfault.read_json(apath)
         kept, audit = audit_sub_policies(
             evaluator, policy_set, fold_paths,
             fold_baselines=fold_baselines,
@@ -1655,12 +1652,11 @@ def search_policies(
         rand_path = os.path.join(save_dir, "random_policy.json")
         n_rand = max(int(result.get("num_sub_policies_selected", 0)), 1)
         if resume and os.path.exists(rand_path):
-            with open(rand_path) as fh:
-                # JSON turns the decoder's (op, prob, level) tuples into
-                # lists — normalize back so resumed and fresh runs are
-                # indistinguishable to callers
-                random_set = [[tuple(op) for op in sub]
-                              for sub in json.load(fh)]
+            # JSON turns the decoder's (op, prob, level) tuples into
+            # lists — normalize back so resumed and fresh runs are
+            # indistinguishable to callers
+            random_set = [[tuple(op) for op in sub]
+                          for sub in fsfault.load_json(rand_path)]
             logger.info("random control: resumed %d drawn sub-policies",
                         len(random_set))
         else:

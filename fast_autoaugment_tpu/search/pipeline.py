@@ -949,6 +949,38 @@ class _FleetRoundBackend:
         return None
 
 
+def _load_fold_resilient(evaluator, fold: int, path: str, *,
+                         budget_s: float = 60.0):
+    """Digest-verified checkpoint read with bounded backoff: on a
+    lagging shared filesystem the published marker can match the
+    sidecar while the PAYLOAD is still half-synced (or a read returns
+    transient EIO/stale bytes), so the digest check inside
+    ``load_checkpoint`` raises — treat that as not-yet-visible and
+    retry until the budget, then raise a typed ``TimeoutError`` (the
+    actor's loud-exit contract; its rounds go to a survivor with a
+    fresher view)."""
+    from fast_autoaugment_tpu.core.resilience import CheckpointCorruptError
+
+    deadline = time.monotonic() + float(budget_s)
+    delay = 0.1
+    while True:
+        try:
+            return evaluator.load_fold(path)
+        except (CheckpointCorruptError, OSError) as e:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fold {fold} checkpoint at {path} never became "
+                    f"readable/digest-clean within {budget_s:.0f}s "
+                    f"(last error: {type(e).__name__}: {e}) — "
+                    "half-synced shared filesystem?") from e
+            logger.warning(
+                "fleet actor: fold %d checkpoint read failed (%s: %s) "
+                "— retrying in %.2fs (visibility lag)", fold,
+                type(e).__name__, e, delay)
+            time.sleep(delay)  # robust: allow — deadline-bounded visibility-lag retry
+            delay = min(1.0, delay * 2)
+
+
 def run_fleet_actor(evaluator, transport: FleetTransport,
                     fold_ckpt_path: Callable[[int], str], *,
                     trial_batch: int = 1, num_policy: int = 5,
@@ -1028,7 +1060,9 @@ def run_fleet_actor(evaluator, transport: FleetTransport,
             transport.wait_checkpoint(fold, path, timeout=ckpt_timeout,
                                       should_stop=should_stop)
             if fold not in loaded:
-                loaded[fold] = evaluator.load_fold(path)
+                loaded[fold] = _load_fold_resilient(
+                    evaluator, fold, path,
+                    budget_s=min(60.0, float(ckpt_timeout)))
             params, batch_stats = loaded[fold]
             rnd = _build_round(
                 int(payload.get("round_idx", 0)),
@@ -1063,7 +1097,17 @@ def run_fleet_actor(evaluator, transport: FleetTransport,
             continue
         except (ArithmeticError, RuntimeError, ValueError, OSError) as e:
             result = {"error": f"{type(e).__name__}: {e}"}
-        transport.post_result(unit, payload, result)
+        try:
+            transport.post_result(unit, payload, result)
+        except LeaseLostError as e:
+            # the done-marker post was FENCED (epoch/owner moved): this
+            # host was presumed dead and the round reclaimed — the
+            # reclaimer posts the same bytes, so abandon, never clobber
+            stats["lease_lost"] += 1
+            logger.warning(
+                "fleet actor: done-marker post for %s fenced off (%s) "
+                "— abandoning the round to its reclaimer", unit, e)
+            continue
         folds_seen.add(fold)
         ok = "rewards" in result
         stats["rounds_ok" if ok else "rounds_err"] += 1

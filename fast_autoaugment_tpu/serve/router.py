@@ -407,15 +407,24 @@ class Router:
         candidate policy `digest`; every `every`-th digest-less request
         routes to them (a deterministic 1/every traffic share), canary-
         digest requests prefer them, other-digest requests avoid them.
-        Re-arming replaces the previous split."""
+        Re-arming with a DIFFERENT split replaces the previous one;
+        re-asserting the SAME split is a no-op that preserves the
+        every-Nth counter — the control loop re-POSTs on every gate
+        poll (docs/CONTROL.md), and a counter reset per poll would
+        starve the canary arm of digest-less traffic."""
         if not tags:
             raise ValueError("canary split needs at least one replica tag")
+        new = {"digest": str(digest),
+               "tags": set(str(t) for t in tags),
+               "every": max(1, int(every))}
         with self._lock:
-            self._canary = {"digest": str(digest),
-                            "tags": set(str(t) for t in tags),
-                            "every": max(1, int(every))}
-            self._canary_count = 0
+            reasserted = self._canary == new
+            if not reasserted:
+                self._canary = new
+                self._canary_count = 0
             snap = dict(self._canary, tags=sorted(self._canary["tags"]))
+        if reasserted:
+            return snap  # idempotent re-assert: no event spam either
         telemetry.emit("canary", self.name, action="split_set",
                        digest=snap["digest"], replicas=snap["tags"],
                        every=snap["every"])

@@ -112,6 +112,102 @@ def test_warm_start_topup_driver_contract(tmp_path):
     assert topped["provenance"]["warm_start"] == ws
 
 
+# ------------------------------- fleet-routed re-search (ISSUE 15 sat)
+
+
+def test_research_through_fleet_learner_actor_byte_identical(tmp_path):
+    """The PR-14 REMAINING item, measured: the control loop's
+    warm-started re-search pointed at a REAL PR-13 learner+actor fleet
+    launch (``search_cli --search-role``) produces artifacts
+    BYTE-IDENTICAL to the controller-host re-search — so
+    ``--research-cmd`` can offload the top-up to a fleet without
+    changing a single candidate byte."""
+    from fast_autoaugment_tpu.control.research import seed_research_dir
+
+    tmp = str(tmp_path)
+    cc = os.path.join(tmp, "cc")
+    conf_yaml = os.path.join(tmp, "conf.yaml")
+    with open(conf_yaml, "w") as fh:
+        fh.write(CONF_YAML)
+    flags = [
+        "-c", conf_yaml, "--dataroot", tmp,
+        "--num-fold", "1", "--num-search", "4", "--num-policy", "1",
+        "--num-op", "1", "--num-top", "2", "--trial-batch", "2",
+        "--until", "2", "--fold-quality-floor", "off",
+        "--audit-floor", "0", "--async-pipeline", "on",
+        "--pipeline-actors", "2", "--pipeline-queue-depth", "2",
+        "--seed", "0", "--compile-cache", cc]
+    cli = [sys.executable, "-m",
+           "fast_autoaugment_tpu.launch.search_cli"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FAA_COMPILE_CACHE=cc)
+    env.pop("FAA_FAULT", None)
+
+    # ---- the base search whose log both re-searches warm-start from
+    base_dir = os.path.join(tmp, "base")
+    r = subprocess.run(cli + flags + ["--save-dir", base_dir], env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    # ---- arm A: the controller-host re-search (the PR-14 default)
+    out_a = os.path.join(tmp, "research_host")
+    seed_research_dir(base_dir, out_a)
+    t0 = time.monotonic()
+    r = subprocess.run(
+        cli + flags + ["--save-dir", out_a, "--topup-trials", "2"],
+        env=env, capture_output=True, text=True, timeout=900)
+    host_wall = time.monotonic() - t0
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    # ---- arm B: the SAME re-search through a learner+actor fleet
+    out_b = os.path.join(tmp, "research_fleet")
+    seed_research_dir(base_dir, out_b)
+    tr = os.path.join(tmp, "transport")
+    fleet_flags = flags + ["--save-dir", out_b, "--topup-trials", "2",
+                           "--fleet-transport", tr, "--lease-ttl", "30"]
+    t0 = time.monotonic()
+    learner = subprocess.Popen(
+        cli + fleet_flags + ["--search-role", "learner",
+                             "--host-id", "0"],
+        env=dict(env, FAA_HOST_ID="0"), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    actor = subprocess.Popen(
+        cli + fleet_flags + ["--search-role", "actor",
+                             "--host-id", "1"],
+        env=dict(env, FAA_HOST_ID="1"), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    out_l = learner.communicate(timeout=900)[0]
+    out_ac = actor.communicate(timeout=300)[0]
+    fleet_wall = time.monotonic() - t0
+    assert learner.returncode == 0, out_l[-3000:]
+    assert actor.returncode == 0, out_ac[-3000:]
+
+    # ---- byte-identity: the fleet path changes NOTHING --------------
+    for name in ("final_policy.json", "search_trials.json"):
+        assert (open(os.path.join(out_a, name), "rb").read()
+                == open(os.path.join(out_b, name), "rb").read()), name
+    res_a = json.load(open(os.path.join(out_a, "search_result.json")))
+    res_b = json.load(open(os.path.join(out_b, "search_result.json")))
+    assert res_a["warm_start"]["topup_trials"] == 2
+    assert res_b["warm_start"] == res_a["warm_start"]
+    # the base prefix is the base log verbatim, extended by the top-up
+    base_log = json.load(open(os.path.join(base_dir,
+                                           "search_trials.json")))
+    log_b = json.load(open(os.path.join(out_b, "search_trials.json")))
+    assert json.dumps(log_b["0"][:4]) == json.dumps(base_log["0"])
+    assert len(log_b["0"]) == 6
+    # the fleet really evaluated remotely: the actor posted rounds
+    assert "fleet_transport" in res_b and "fleet_transport" not in res_a
+    import bench
+
+    print("RESEARCH_FLEET " + json.dumps({
+        "research_fleet": {
+            "host_wall_sec": round(host_wall, 1),
+            "fleet_wall_sec": round(fleet_wall, 1),
+            "topup_trials": 2,
+            "single_core_caveat": True,
+        }, **bench.telemetry_stamp()}))
+
+
 # ----------------------------------------------------------- THE drill
 
 

@@ -1,0 +1,292 @@
+"""Crash-resumable control loop (ISSUE 15): the journal-as-WAL
+reconstruction (``control/resume.py``), idempotent stage re-entry
+(``ControlLoop.resume``), and the per-poll canary split RE-ASSERT with
+echo verification — all fast, host-only, on stub transports.
+
+The live SIGKILL-mid-canary drill (real replicas + router +
+``control_cli --resume``) is tests/test_control_resume_e2e.py (slow).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from fast_autoaugment_tpu.core import telemetry as T
+from fast_autoaugment_tpu.control import (
+    CanaryController,
+    ControlLoop,
+    DriftMonitor,
+    PromotionGate,
+    load_provenance,
+    policy_file_digest,
+    read_control_events,
+    reconstruct_inflight_episode,
+    write_provenance,
+)
+from fast_autoaugment_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("FAA_TELEMETRY", raising=False)
+    monkeypatch.delenv("FAA_FAULT", raising=False)
+    faultinject.reset()
+    T.registry()._reset_for_tests()
+    yield
+    T._disable_for_tests()
+    faultinject.reset()
+
+
+@pytest.fixture()
+def journal_dir(tmp_path):
+    d = str(tmp_path / "tel")
+    T.enable_telemetry(d, tb_bridge=False)
+    yield d
+    T._disable_for_tests()
+
+
+def _journal_records(directory):
+    T.journal_flush()
+    records = []
+    for path in sorted(glob.glob(
+            os.path.join(directory, "journal-*.jsonl"))):
+        with open(path) as fh:
+            records.extend(json.loads(ln) for ln in fh if ln.strip())
+    records.sort(key=lambda r: r["seq"])
+    return records
+
+
+# ------------------------------------------- WAL reconstruction (pure)
+
+
+def _ev(etype, seq, **fields):
+    return {"type": etype, "host": "h0", "pid": 1, "seq": seq, **fields}
+
+
+def test_clean_wal_reconstructs_nothing():
+    events = [
+        _ev("drift", 1, id="drift-1", metric="input_mean"),
+        _ev("research", 2, candidate="/c.json", digest="abc"),
+        _ev("canary", 3, action="rollout", replica="replica1"),
+        _ev("promote", 4, digest="abc"),
+    ]
+    assert reconstruct_inflight_episode(events) is None
+    # rollback and the terminal marks close an episode too
+    for closer in (_ev("rollback", 4),
+                   _ev("mark", 4, event="research_failed"),
+                   _ev("mark", 4, event="candidate_is_baseline")):
+        assert reconstruct_inflight_episode(events[:1] + [closer]) is None
+
+
+def test_dangling_research_stage_reconstructs():
+    events = [_ev("drift", 1, id="drift-1", metric="input_mean",
+                  stat=12.0)]
+    ep = reconstruct_inflight_episode(events)
+    assert ep is not None
+    assert ep["stage"] == "research"
+    assert ep["verdict"]["id"] == "drift-1"
+    assert ep["verdict"]["stat"] == 12.0
+    # journal envelope keys are stripped from the verdict
+    assert "seq" not in ep["verdict"] and "host" not in ep["verdict"]
+
+
+def test_dangling_canary_stage_reconstructs_with_candidate():
+    events = [
+        _ev("drift", 1, id="drift-1"),
+        _ev("research", 2, candidate="/cand/final_policy.json",
+            digest="abc123def456"),
+        _ev("canary", 3, action="rollout", replica="replica1"),
+    ]
+    ep = reconstruct_inflight_episode(events)
+    assert ep["stage"] == "canary"
+    assert ep["candidate"] == "/cand/final_policy.json"
+    assert ep["digest"] == "abc123def456"
+
+
+def test_only_the_last_episode_dangles():
+    events = [
+        _ev("drift", 1, id="drift-1"),
+        _ev("research", 2, candidate="/c1.json", digest="d1"),
+        _ev("promote", 3, digest="d1"),
+        _ev("drift", 4, id="drift-2"),
+        _ev("research", 5, candidate="/c2.json", digest="d2"),
+    ]
+    ep = reconstruct_inflight_episode(events)
+    assert ep["verdict"]["id"] == "drift-2" and ep["digest"] == "d2"
+
+
+def test_read_control_events_from_journal_with_torn_tail(tmp_path):
+    tel = str(tmp_path / "tel")
+    os.makedirs(tel)
+    path = os.path.join(tel, "journal-0.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(_ev("drift", 1, id="drift-1")) + "\n")
+        fh.write(json.dumps(_ev("dispatch", 2)) + "\n")  # not control
+        fh.write(json.dumps(_ev("research", 3, candidate="/c",
+                                digest="d")) + "\n")
+        fh.write('{"type": "promote", "seq": 4, "trunc')  # torn tail
+    events = read_control_events(tel)
+    assert [e["type"] for e in events] == ["drift", "research"]
+    ep = reconstruct_inflight_episode(events)
+    assert ep["stage"] == "canary"  # the torn promote never happened
+
+
+# ------------------------------------------------ loop resume (stubs)
+
+
+class _StubRouter:
+    """The router's /canary admin as a stateful stub: records every
+    admin call and echoes the armed split like the real handler."""
+
+    def __init__(self):
+        self.split: dict | None = None
+        self.calls: list[dict] = []
+        self.echo_override: dict | None = None
+
+    def __call__(self, payload: dict):
+        self.calls.append(dict(payload))
+        if self.echo_override is not None:
+            return self.echo_override
+        if payload.get("clear"):
+            self.split = None
+            return {"canary": None}
+        self.split = {"digest": payload["digest"],
+                      "tags": list(payload["replicas"]),
+                      "every": payload.get("every", 2)}
+        return {"canary": dict(self.split)}
+
+
+def _mk_loop(tmp_path, journal_dir):
+    policy = [[["Rotate", 0.5, 0.4], ["Invert", 0.2, 0.0]]]
+    base = str(tmp_path / "baseline.json")
+    cand = str(tmp_path / "candidate.json")
+    with open(base, "w") as fh:
+        json.dump(policy, fh)
+    with open(cand, "w") as fh:
+        json.dump([[["ShearX", 0.9, 0.1], ["Solarize", 0.3, 0.7]]], fh)
+    write_provenance(cand, {"kind": "test_candidate"})
+    reloads = []
+
+    def reload_fn(host, port, policy_path):
+        reloads.append((host, policy_path))
+        return {"digest": policy_file_digest(policy_path)}
+
+    replicas = [{"tag": f"replica{i}", "host": "h", "port": 9000 + i}
+                for i in range(3)]
+    ctl = CanaryController(lambda: list(replicas), reload_fn=reload_fn,
+                           router_url="http://stub")
+    router = _StubRouter()
+    ctl._router_canary = router
+
+    class _Scraper:
+        def sample(self, reps):
+            return {str(r["tag"]): {
+                "reachable": True, "reward_proxy": 0.1,
+                "new_dispatches": 5, "new_breaker_fires": 0,
+                "dispatches": 5, "breaker_fires": 0} for r in reps}
+
+    monitor = DriftMonitor(lambda: [], baseline_n=5)
+    loop = ControlLoop(
+        monitor, lambda verdict: {"policy": cand,
+                                  "provenance": load_provenance(cand)},
+        ctl, PromotionGate(gate_polls=2, quality_margin=10.0),
+        _Scraper(), baseline_policy=base,
+        baseline_digest=policy_file_digest(base), n_canary=1,
+        split_every=2)
+    return loop, router, reloads, cand, policy_file_digest(cand)
+
+
+def test_resume_canary_stage_terminates_in_promote(tmp_path,
+                                                   journal_dir):
+    """The resumed-controller shape: a fresh loop adopts a dangling
+    canary-stage episode, idempotently re-runs the rollout (digest
+    re-verify + split re-arm) and drives it to a journaled promote."""
+    loop, router, reloads, cand, cand_digest = _mk_loop(tmp_path,
+                                                        journal_dir)
+    episode = {"verdict": {"id": "drift-9", "metric": "input_mean"},
+               "stage": "canary", "candidate": cand,
+               "digest": cand_digest, "provenance": {}}
+    assert loop.resume(episode) == "canary"
+    assert loop.step() == "canary"     # adoption
+    assert loop.step() == "observing"  # idempotent rollout + split
+    assert router.split["digest"] == cand_digest
+    assert loop.step() == "observing"  # gate 1/2 (split re-asserted)
+    assert loop.step() == "watching"   # gate 2/2 -> promote
+    assert router.split is None        # promote cleared the split
+    evs = _journal_records(journal_dir)
+    marks = [r for r in evs if r["type"] == "mark"
+             and r.get("event") == "resume"]
+    assert marks and marks[0]["stage"] == "canary"
+    promotes = [r for r in evs if r["type"] == "promote"]
+    assert promotes and promotes[0]["digest"] == cand_digest
+    assert promotes[0]["drift_id"] == "drift-9"
+    assert loop.baseline_digest == cand_digest
+
+
+def test_resume_research_stage_reenters_research(tmp_path, journal_dir):
+    loop, router, reloads, cand, cand_digest = _mk_loop(tmp_path,
+                                                        journal_dir)
+    episode = {"verdict": {"id": "drift-7"}, "stage": "research",
+               "candidate": None, "digest": None}
+    assert loop.resume(episode) == "research"
+    assert loop.step() == "research"  # adoption
+    assert loop.step() == "canary"    # re-search re-ran
+    assert loop.step() == "observing"
+
+
+def test_router_restart_mid_canary_is_reasserted_every_poll(
+        tmp_path, journal_dir):
+    """THE satellite pin: a restarted router (split lost, 100% baseline
+    routing) is re-armed by the next gate poll's idempotent POST
+    /canary — the gate never scores a phantom canary arm for more than
+    one poll."""
+    loop, router, reloads, cand, cand_digest = _mk_loop(tmp_path,
+                                                        journal_dir)
+    episode = {"verdict": {"id": "drift-1"}, "stage": "canary",
+               "candidate": cand, "digest": cand_digest}
+    loop.resume(episode)
+    loop.step()                        # adopt
+    assert loop.step() == "observing"  # rollout, split armed
+    router.split = None                # <-- the router restarts
+    assert loop.step() == "observing"  # next poll...
+    assert router.split is not None    # ...re-armed the split
+    assert router.split["digest"] == cand_digest
+    # every observe poll carried a split (re-)assert admin call
+    sets = [c for c in router.calls if c.get("digest") == cand_digest]
+    assert len(sets) >= 2
+
+
+def test_split_echo_mismatch_rolls_back(tmp_path, journal_dir):
+    """A router echoing a DIFFERENT armed digest (another controller
+    owns the split) must roll back, not fight over traffic."""
+    loop, router, reloads, cand, cand_digest = _mk_loop(tmp_path,
+                                                        journal_dir)
+    episode = {"verdict": {"id": "drift-2"}, "stage": "canary",
+               "candidate": cand, "digest": cand_digest}
+    loop.resume(episode)
+    loop.step()                        # adopt
+    assert loop.step() == "observing"  # rollout ok
+    router.echo_override = {"canary": {"digest": "someone-else"}}
+    assert loop.step() == "watching"   # re-assert mismatch -> rollback
+    evs = _journal_records(journal_dir)
+    assert any(r["type"] == "rollback" for r in evs)
+    assert loop.stats()["rollbacks"] == 1
+
+
+def test_control_cli_resume_flag_parses():
+    from fast_autoaugment_tpu.launch.control_cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--telemetry", "/t", "--port-dir", "/p",
+         "--baseline-policy", "/b.json", "--candidate-policy",
+         "/c.json", "--resume"])
+    assert args.resume is True
+    args = build_parser().parse_args(
+        ["--telemetry", "/t", "--port-dir", "/p",
+         "--baseline-policy", "/b.json", "--candidate-policy",
+         "/c.json"])
+    assert args.resume is False

@@ -68,7 +68,8 @@ def test_every_rule_declares_severity_and_pass():
     for rule in default_rules():
         assert rule.severity in ("error", "warning", "info"), rule.id
         assert rule.pass_name in ("robustness", "concurrency",
-                                  "dispatch", "determinism"), rule.id
+                                  "dispatch", "determinism",
+                                  "fsseam"), rule.id
 
 
 def test_baseline_requires_reason(tmp_path):
@@ -491,6 +492,68 @@ def test_historical_bugs_each_caught_by_exactly_one_pass():
         assert hit_passes == {intended}, (name, hit_passes)
         assert {f.rule for f in findings} == expected, name
         assert not check_source(corpus_load(name, "postfix"), relpath), name
+
+
+# ------------------------------------------------------------------- F1
+
+
+SEARCH = "fast_autoaugment_tpu/search/x.py"
+CONTROL = "fast_autoaugment_tpu/control/x.py"
+
+
+def test_f1_direct_shared_dir_io_flagged_in_fsseam_scopes_only():
+    src = ("import json, os\n"
+           "def f(d):\n"
+           "    names = os.listdir(d)\n"
+           "    with open(os.path.join(d, names[0])) as fh:\n"
+           "        return json.load(fh)\n")
+    for scope in (LAUNCH, SEARCH, CONTROL):
+        assert _rules(check_source(src, scope)).count("F1") == 3, scope
+    # core/ holds the seam itself; train/ has no shared-dir protocol
+    assert "F1" not in _rules(check_source(src, CORE))
+    assert "F1" not in _rules(check_source(src, TRAIN))
+
+
+def test_f1_shapes_stat_getsize_glob():
+    src = ("import glob, os\n"
+           "def f(d, p):\n"
+           "    a = os.stat(p)\n"
+           "    b = os.path.getsize(p)\n"
+           "    c = glob.glob(os.path.join(d, '*.json'))\n"
+           "    return a, b, c\n")
+    assert _rules(check_source(src, CONTROL)).count("F1") == 3
+    # json.loads (string-level) and os.path.join are not I/O
+    src2 = ("import json, os\n"
+            "def f(s, d):\n"
+            "    return json.loads(s), os.path.join(d, 'x')\n")
+    assert not check_source(src2, CONTROL)
+
+
+def test_f1_seam_primitives_and_writer_are_clean():
+    src = ("from fast_autoaugment_tpu.core import fsfault\n"
+           "def f(d, p):\n"
+           "    rec = fsfault.read_json(p)\n"
+           "    names = fsfault.listdir(d)\n"
+           "    fsfault.write_json_atomic(p, rec)\n"
+           "    return names\n")
+    assert not check_source(src, LAUNCH)
+    # the atomic-writer primitive is the seam's own delegate (the R3
+    # allowlist idiom): its internal open() is exempt by function name
+    writer = ("import json, os\n"
+              "def write_json_atomic(path, obj):\n"
+              "    tmp = path + '.tmp'\n"
+              "    with open(tmp, 'w') as fh:\n"
+              "        json.dump(obj, fh)\n"
+              "    os.replace(tmp, path)\n")
+    assert "F1" not in _rules(check_source(writer, SEARCH))
+
+
+def test_f1_robust_allow_suppression():
+    src = ("import json\n"
+           "def f(p):\n"
+           "    with open(p) as fh:  # robust: allow — local-only file\n"
+           "        return json.load(fh)  # robust: allow — local-only\n")
+    assert not check_source(src, LAUNCH)
 
 
 # -------------------------------------------------------------- live gates

@@ -536,17 +536,21 @@ def test_steal_fence_cross_process_racing_claimants(tmp_path):
         "from fast_autoaugment_tpu.launch.workqueue import WorkQueue\n"
         "root, owner, go = sys.argv[1:4]\n"
         "q = WorkQueue(root, owner, lease_ttl=1.0)\n"
+        "assert not q.claim('unit-x')  # observer-local: watch first\n"
+        "t_obs = time.monotonic()\n"
         "deadline = time.monotonic() + 60\n"
         "while not os.path.exists(go):\n"
         "    if time.monotonic() > deadline: sys.exit(3)\n"
         "    time.sleep(0.005)\n"
+        "# everyone's observation must be a full TTL old at race time\n"
+        "time.sleep(max(0.0, 1.05 - (time.monotonic() - t_obs)))\n"
         "print('WON' if q.claim('unit-x') else 'LOST')\n")
     procs = [subprocess.Popen(
         [sys.executable, "-c", script, str(root), f"racer{i}", str(go)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
         for i in range(4)]
-    time.sleep(1.0)  # let the interpreters reach the gate
+    time.sleep(2.0)  # let the interpreters reach the gate + observe
     go.write_text("go")
     outs = [p.communicate(timeout=300) for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
@@ -554,6 +558,7 @@ def test_steal_fence_cross_process_racing_claimants(tmp_path):
     assert sorted(verdicts) == ["LOST", "LOST", "LOST", "WON"]
     lease = json.load(open(root / "leases" / "unit-x.json"))
     assert lease["attempt"] == 2
+    assert lease["epoch"] == 2  # the fencing token rode the steal
     assert lease["reclaimed_from"] == "dead-host"
     assert lease["owner"].startswith("racer")
     # the fence file never survives the steal

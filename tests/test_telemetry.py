@@ -403,8 +403,9 @@ def test_lease_events_counters_and_journal(journal_dir, tmp_path):
     q1 = WorkQueue(str(tmp_path / "wq"), "hostA", lease_ttl=0.05)
     assert q1.claim("u1")
     q2 = WorkQueue(str(tmp_path / "wq"), "hostB", lease_ttl=0.05)
-    time.sleep(0.12)  # hostA's lease goes stale
-    assert q2.claim("u1")  # reclaim
+    assert not q2.claim("u1")  # hostB observes the foreign lease...
+    time.sleep(0.12)           # ...which sits unchanged past the TTL
+    assert q2.claim("u1")  # reclaim (observer-local staleness)
     q2.release("u1", info={"ok": True})
     assert claims.value == c0 + 1
     assert reclaims.value == r0 + 1

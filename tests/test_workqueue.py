@@ -1,7 +1,9 @@
-"""Lease-queue units: claim/renew/steal races, stale reclaim, done
-markers, host census, and the degraded-mode accounting — all fast,
-host-only, no jax.  The multi-process story is the slow self-healing
-e2e (tests/test_selfheal_fleet.py).
+"""Lease-queue units: claim/renew/steal races, observer-local stale
+reclaim, epoch fencing, done markers, host census, and the
+degraded-mode accounting — all fast, host-only, no jax.  The
+multi-process story is the slow self-healing e2e
+(tests/test_selfheal_fleet.py); the hostile-filesystem story is
+tests/test_fsfault.py.
 """
 
 from __future__ import annotations
@@ -33,13 +35,13 @@ def _q(tmp_path, owner, ttl=60.0):
     return WorkQueue(str(tmp_path / "wq"), owner, lease_ttl=ttl)
 
 
-def _age_lease(q: WorkQueue, unit: str, by: float):
-    """Backdate a lease's heartbeat (simulate a dead owner)."""
-    path = q._lease_path(unit)
-    rec = json.load(open(path))
-    rec["heartbeat"] -= by
-    with open(path, "w") as fh:  # test-only surgery
-        json.dump(rec, fh)
+def _watch_out_ttl(q: WorkQueue, unit: str, margin: float = 0.05):
+    """Observer-local staleness: the claimant must WATCH the foreign
+    lease sit unchanged for a full TTL on its own clock before a
+    reclaim is allowed.  First claim observes (and declines), the wait
+    makes the observation stale."""
+    assert not q.claim(unit)  # records the observation
+    time.sleep(q.lease_ttl + margin)
 
 
 def test_claim_fresh_and_mutual_exclusion(tmp_path):
@@ -48,6 +50,7 @@ def test_claim_fresh_and_mutual_exclusion(tmp_path):
     assert not b.claim("u1")  # live lease elsewhere
     lease = b.read_lease("u1")
     assert lease["owner"] == "a" and lease["attempt"] == 1
+    assert lease["epoch"] == 1  # the fencing token, from birth
 
 
 def test_reclaim_own_lease_after_restart(tmp_path):
@@ -55,7 +58,9 @@ def test_reclaim_own_lease_after_restart(tmp_path):
     assert a.claim("u1")
     a2 = _q(tmp_path, "a")  # the relaunched process, same owner tag
     assert a2.claim("u1")   # immediate, no TTL wait
-    assert a2.read_lease("u1")["attempt"] == 1  # not a steal
+    lease = a2.read_lease("u1")
+    assert lease["attempt"] == 1  # not a steal
+    assert lease["epoch"] == 1    # same ownership chain, same epoch
 
 
 def test_renew_refreshes_heartbeat(tmp_path):
@@ -64,29 +69,94 @@ def test_renew_refreshes_heartbeat(tmp_path):
     hb0 = a.read_lease("u1")["heartbeat"]
     time.sleep(0.02)
     a.renew("u1")
-    assert a.read_lease("u1")["heartbeat"] > hb0
+    lease = a.read_lease("u1")
+    assert lease["heartbeat"] > hb0
+    assert lease["epoch"] == 1  # renewals carry the token forward
 
 
-def test_stale_lease_is_reclaimed_with_attempt_bump(tmp_path):
-    a, b = _q(tmp_path, "a", ttl=5.0), _q(tmp_path, "b", ttl=5.0)
+def test_stale_lease_is_reclaimed_with_attempt_and_epoch_bump(tmp_path):
+    a, b = _q(tmp_path, "a", ttl=0.15), _q(tmp_path, "b", ttl=0.15)
     assert a.claim("u1")
-    assert not b.claim("u1")      # still fresh
-    _age_lease(a, "u1", by=60.0)  # owner died a minute ago
-    assert b.claim("u1")
+    _watch_out_ttl(b, "u1")       # b observes the dead owner's lease
+    assert b.claim("u1")          # ...and reclaims past ITS OWN ttl
     lease = b.read_lease("u1")
     assert lease["owner"] == "b"
     assert lease["attempt"] == 2
+    assert lease["epoch"] == 2    # fencing token advanced
     assert lease["reclaimed_from"] == "a"
     assert b.reclaimed_units == ["u1"]
 
 
+def test_live_renewals_reset_the_observer_clock(tmp_path):
+    """A SLOW owner that still heartbeats is never robbed: every renew
+    changes the lease fingerprint, restarting the observer's staleness
+    window."""
+    a, b = _q(tmp_path, "a", ttl=0.2), _q(tmp_path, "b", ttl=0.2)
+    assert a.claim("u1")
+    for _ in range(3):
+        assert not b.claim("u1")
+        time.sleep(0.15)     # under the ttl each time...
+        a.renew("u1")        # ...and the owner keeps beating
+    assert not b.claim("u1")  # total elapsed >> ttl, still not stale
+
+
+def test_skewed_heartbeat_stamps_cannot_fake_or_hide_death(tmp_path,
+                                                           monkeypatch):
+    """The skew-proof pin: a lease whose heartbeat STAMP is 10 minutes
+    in the future (or past) reclaims on exactly the same observer-local
+    schedule — wall stamps are compared for identity, never against
+    the observer's clock."""
+    a, b = _q(tmp_path, "a", ttl=0.15), _q(tmp_path, "b", ttl=0.15)
+    assert a.claim("u1")
+    path = a._lease_path("u1")
+    rec = json.load(open(path))
+    rec["heartbeat"] += 600.0  # a wildly fast clock on the owner host
+    with open(path, "w") as fh:  # test-only surgery
+        json.dump(rec, fh)
+    _watch_out_ttl(b, "u1")
+    assert b.claim("u1")  # future stamp did not immortalize the zombie
+    assert b.read_lease("u1")["epoch"] == 2
+
+    a2, c = _q(tmp_path, "a2", ttl=60.0), _q(tmp_path, "c", ttl=60.0)
+    assert a2.claim("u2")
+    path = a2._lease_path("u2")
+    rec = json.load(open(path))
+    rec["heartbeat"] -= 600.0  # a wildly slow clock on the owner host
+    with open(path, "w") as fh:
+        json.dump(rec, fh)
+    # under the OLD wall-compare scheme this looked 10 min stale and
+    # was robbed instantly; observer-local staleness declines
+    assert not c.claim("u2")
+
+
 def test_renew_after_steal_raises_lease_lost(tmp_path):
-    a, b = _q(tmp_path, "a", ttl=5.0), _q(tmp_path, "b", ttl=5.0)
+    a, b = _q(tmp_path, "a", ttl=0.15), _q(tmp_path, "b", ttl=0.15)
     a.claim("u1")
-    _age_lease(a, "u1", by=60.0)
+    _watch_out_ttl(b, "u1")
     assert b.claim("u1")
     with pytest.raises(LeaseLostError):
         a.renew("u1")  # the presumed-dead owner must stop working
+
+
+def test_zombie_release_is_fenced_off(tmp_path):
+    """THE fencing pin: a robbed zombie's late done-marker post raises
+    instead of clobbering the reclaimed unit's completion record."""
+    a, b = _q(tmp_path, "a", ttl=0.15), _q(tmp_path, "b", ttl=0.15)
+    a.claim("u1")
+    _watch_out_ttl(b, "u1")
+    assert b.claim("u1")          # epoch 2, owner b
+    with pytest.raises(LeaseLostError):
+        a.release("u1", info={"rewards": [0.0]})  # zombie write FENCED
+    assert not a.is_done("u1")    # nothing was clobbered
+    b.release("u1", info={"rewards": [1.0]})
+    done = b.done_record("u1")
+    assert done["owner"] == "b" and done["epoch"] == 2
+    assert done["info"] == {"rewards": [1.0]}
+    # and a zombie racing AFTER the reclaimer finished is fenced by the
+    # done marker's epoch even though the lease file is gone
+    with pytest.raises(LeaseLostError):
+        a.release("u1", info={"rewards": [0.0]})
+    assert b.done_record("u1")["info"] == {"rewards": [1.0]}
 
 
 def test_release_writes_done_marker_and_blocks_reclaim(tmp_path):
@@ -97,6 +167,24 @@ def test_release_writes_done_marker_and_blocks_reclaim(tmp_path):
     assert not b.claim("u1")  # done units are never re-claimed
     assert b.done_info("u1") == {"baseline": 0.9, "excluded": False}
     assert a.read_lease("u1") is None  # lease cleaned up
+    assert a.done_record("u1")["epoch"] == 1
+    a.release("u1", info={"baseline": 0.9})  # idempotent re-release
+
+
+def test_old_format_lease_without_epoch_still_reclaims(tmp_path):
+    """Additive-format pin: a lease written by a pre-epoch build (no
+    ``epoch`` field) reclaims normally and enters the sequence at 2."""
+    a, b = _q(tmp_path, "a", ttl=0.15), _q(tmp_path, "b", ttl=0.15)
+    a.claim("u1")
+    path = a._lease_path("u1")
+    rec = json.load(open(path))
+    del rec["epoch"]
+    with open(path, "w") as fh:  # the old on-disk format
+        json.dump(rec, fh)
+    _watch_out_ttl(b, "u1")
+    assert b.claim("u1")
+    lease = b.read_lease("u1")
+    assert lease["attempt"] == 2 and lease["epoch"] == 2
 
 
 def test_claim_race_exactly_one_winner(tmp_path):
@@ -118,10 +206,12 @@ def test_claim_race_exactly_one_winner(tmp_path):
 
 
 def test_steal_race_exactly_one_winner(tmp_path):
-    dead = _q(tmp_path, "dead", ttl=1.0)
+    dead = _q(tmp_path, "dead", ttl=0.15)
     dead.claim("u1")
-    _age_lease(dead, "u1", by=60.0)
-    queues = [_q(tmp_path, f"h{i}", ttl=1.0) for i in range(8)]
+    queues = [_q(tmp_path, f"h{i}", ttl=0.15) for i in range(8)]
+    for q in queues:
+        assert not q.claim("u1")  # everyone observes the dead lease
+    time.sleep(0.25)              # ...and watches out the ttl
     wins = []
     barrier = threading.Barrier(len(queues))
 
@@ -138,6 +228,7 @@ def test_steal_race_exactly_one_winner(tmp_path):
     assert len(wins) == 1, wins
     lease = queues[0].read_lease("u1")
     assert lease["owner"] == wins[0] and lease["attempt"] == 2
+    assert lease["epoch"] == 2
 
 
 def test_host_beats_and_lost_census(tmp_path):
@@ -164,22 +255,23 @@ def test_lost_census_never_lists_the_caller(tmp_path):
     assert b.lost_hosts() == ["a"]  # another host MAY call it lost
 
 
-def test_accounting_reports_global_reclaims(tmp_path):
-    a, b = _q(tmp_path, "a", ttl=5.0), _q(tmp_path, "b", ttl=5.0)
+def test_accounting_reports_global_reclaims_with_epochs(tmp_path):
+    a, b = _q(tmp_path, "a", ttl=0.15), _q(tmp_path, "b", ttl=0.15)
     a.claim("u1")
-    _age_lease(a, "u1", by=60.0)
+    _watch_out_ttl(b, "u1")
     b.claim("u1")
     b.release("u1")
     b.claim("u2")
     b.release("u2")
     # a THIRD host (no session-local reclaim state) sees the same story
-    c = _q(tmp_path, "c", ttl=5.0)
+    c = _q(tmp_path, "c", ttl=0.15)
     acct = c.accounting()
     assert acct["degraded"] is True
     assert acct["num_reclaimed_units"] == 1
     rec = acct["reclaimed_units"][0]
     assert rec["unit"] == "u1" and rec["finished_by"] == "b" \
         and rec["reclaimed_from"] == "a"
+    assert rec["epoch"] == 2  # the reclaim provenance rides the marker
 
 
 def test_accounting_clean_run_not_degraded(tmp_path):

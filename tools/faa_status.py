@@ -232,6 +232,45 @@ def search_fleet_status(root: str, journal: list[dict],
         _row(owner).setdefault("role", None)
         hosts[owner]["claimed_units"] = sorted(units)
 
+    # per-unit lease epochs (the fencing tokens): epoch > 1 = the unit
+    # was reclaimed at least once; the provenance any host can read
+    lease_epochs = {
+        unit: {"epoch": int(rec.get("epoch", 1)),
+               "owner": rec.get("owner"),
+               "attempt": int(rec.get("attempt", 1)),
+               **({"reclaimed_from": rec["reclaimed_from"]}
+                  if rec.get("reclaimed_from") else {})}
+        for unit, rec in sorted(leases.items())}
+
+    # skew suspects: a lease heartbeat or host beat stamped in THIS
+    # observer's future means the writer's wall clock runs ahead —
+    # harmless to reclaim correctness (observer-local staleness), but
+    # worth a loud line before someone trusts a wall comparison
+    now = time.time()
+    margin = 2.0  # NTP-honest hosts stay well inside this
+    skew_suspects = []
+    for unit, rec in sorted(leases.items()):
+        hb = rec.get("heartbeat")
+        if isinstance(hb, (int, float)) and hb > now + margin:
+            skew_suspects.append({
+                "kind": "lease", "name": unit,
+                "owner": rec.get("owner"),
+                "ahead_sec": round(float(hb) - now, 1)})
+    for owner, rec in sorted(beats.items()):
+        hb = rec.get("heartbeat")
+        if isinstance(hb, (int, float)) and hb > now + margin:
+            skew_suspects.append({
+                "kind": "host", "name": owner,
+                "ahead_sec": round(float(hb) - now, 1)})
+
+    # fs-fault injection counters (the FAA_FSFAULT seam journals one
+    # typed event per injection): what the hostile substrate did
+    fsfault_counts: dict[str, int] = {}
+    for r in journal:
+        if r.get("type") == "fsfault":
+            kind = str(r.get("label"))
+            fsfault_counts[kind] = fsfault_counts.get(kind, 0) + 1
+
     # in-flight window occupancy: published rounds with no result yet
     open_rounds: list[str] = []
     work_dir = os.path.join(root, "work")
@@ -273,6 +312,9 @@ def search_fleet_status(root: str, journal: list[dict],
         "concurrent_lane_secs": round(total_overlap, 3),
         "search_done": os.path.exists(
             os.path.join(root, "search_done.json")),
+        "lease_epochs": lease_epochs,
+        "skew_suspects": skew_suspects,
+        "fsfault_injections": fsfault_counts,
     }
 
 
@@ -589,6 +631,26 @@ def render_table(status: dict) -> str:
                          f"{pr['overlap_secs']}s")
         else:
             tail += "\n  concurrent lanes (distinct hosts): none observed"
+        epochs = fleet_search.get("lease_epochs") or {}
+        reclaimed_leases = {u: r for u, r in epochs.items()
+                            if r["epoch"] > 1}
+        if epochs:
+            tail += (f"\n  lease epochs: {len(epochs)} live lease(s), "
+                     f"{len(reclaimed_leases)} past epoch 1")
+            for unit, rec in list(reclaimed_leases.items())[:6]:
+                tail += (f"\n    {unit}: epoch {rec['epoch']} "
+                         f"owner {rec['owner']}"
+                         + (f" (reclaimed from {rec['reclaimed_from']})"
+                            if rec.get("reclaimed_from") else ""))
+        fs_counts = fleet_search.get("fsfault_injections") or {}
+        if fs_counts:
+            tail += "\n  fs-fault injections: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(fs_counts.items()))
+        for sus in fleet_search.get("skew_suspects") or []:
+            tail += (f"\n  WARNING skew suspect: {sus['kind']} "
+                     f"{sus['name']} heartbeat {sus['ahead_sec']}s in "
+                     "this observer's FUTURE (writer clock runs ahead; "
+                     "lease reclaim is observer-local and unaffected)")
     serving = status.get("serving")
     if serving:
         tail += "\n\nserving plane:"

@@ -70,6 +70,10 @@ DISPATCH_DIRS = ("train", "search", "serve")
 # lease/heartbeat records are wall-clock + pid stamped BY DESIGN —
 # staleness detection is their function, not a determinism bug.
 DETERMINISM_DIRS = ("core", "search", "train")
+# F1: the shared-directory layers whose file I/O must route through
+# the core/fsfault.py fault seam (docs/RESILIENCE.md "Hostile shared
+# filesystem") — the seam is core/, so it polices itself out of scope.
+FSSEAM_DIRS = ("launch", "search", "control")
 
 SCOPE_DIRS = {
     "artifact": ARTIFACT_DIRS,
@@ -81,6 +85,7 @@ SCOPE_DIRS = {
     "ext_blocking": EXT_BLOCKING_DIRS,
     "dispatch": DISPATCH_DIRS,
     "determinism": DETERMINISM_DIRS,
+    "fsseam": FSSEAM_DIRS,
     # C1/C2 run package-wide: threads and locks are legal anywhere, so
     # the analysis follows them anywhere
     "concurrency": None,
@@ -341,12 +346,13 @@ class Rule:
 def default_rules() -> list[Rule]:
     """The full registered rule set, one instance per rule id."""
     from . import rules_concurrency, rules_determinism, rules_dispatch, \
-        rules_robustness
+        rules_fsseam, rules_robustness
 
     return (rules_robustness.RULES()
             + rules_concurrency.RULES()
             + rules_dispatch.RULES()
-            + rules_determinism.RULES())
+            + rules_determinism.RULES()
+            + rules_fsseam.RULES())
 
 
 LEGACY_RULE_IDS = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")

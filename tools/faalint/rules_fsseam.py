@@ -1,0 +1,97 @@
+"""FS-seam pass: shared-directory I/O must route through the
+``core/fsfault.py`` fault seam.
+
+PR 15 made the shared-filesystem layers (``launch/``, ``search/``,
+``control/``) hostile-substrate-safe by funneling every shared-dir
+read/list through ``core/fsfault.py`` — which is also where the
+``FAA_FSFAULT`` drills inject lag / stale reads / transient EIO / torn
+tails.  A direct ``open``/``os.listdir``/``os.stat``/``json.load``
+added later in those layers would silently bypass both the hardening
+and the drills (the seam would rot exactly like an unexercised
+recovery path).  Rule F1 pins the funnel.
+
+Exemptions mirror the R3 atomic-writer idiom: code inside a function
+named ``write_json_atomic``/``_write_json_atomic`` IS the seam's
+delegate, and ``# robust: allow`` escapes the rest (local-only files,
+process-private scratch) with the justification on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Finding, Rule
+
+#: the enclosing-function names that ARE the seam/writer primitives
+_WRITER_FUNCS = {"write_json_atomic", "_write_json_atomic"}
+
+
+def _call_desc(call: ast.Call) -> str | None:
+    """A flagged call's description, or None when the call is not one
+    of the direct-I/O shapes F1 polices."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return "open(...)"
+    if not isinstance(f, ast.Attribute):
+        return None
+    # os.listdir / os.stat / os.scandir
+    if isinstance(f.value, ast.Name) and f.value.id == "os" \
+            and f.attr in ("listdir", "stat", "scandir"):
+        return f"os.{f.attr}(...)"
+    # os.path.getsize / os.path.getmtime
+    if isinstance(f.value, ast.Attribute) and f.value.attr == "path" \
+            and isinstance(f.value.value, ast.Name) \
+            and f.value.value.id == "os" \
+            and f.attr in ("getsize", "getmtime"):
+        return f"os.path.{f.attr}(...)"
+    # json.load (json.loads is string-level, not I/O)
+    if isinstance(f.value, ast.Name) and f.value.id == "json" \
+            and f.attr == "load":
+        return "json.load(...)"
+    # glob.glob / glob.iglob (shared-dir discovery)
+    if isinstance(f.value, ast.Name) and f.value.id == "glob" \
+            and f.attr in ("glob", "iglob"):
+        return f"glob.{f.attr}(...)"
+    return None
+
+
+class SharedDirIOSeamRule(Rule):
+    """F1: direct filesystem I/O in the shared-dir layers outside the
+    ``core/fsfault.py`` seam."""
+
+    id = "F1"
+    severity = "error"
+    pass_name = "fsseam"
+    scope_key = "fsseam"
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        func_of_line = ctx.outer_func_of_line()
+        for call in ctx.of(ast.Call):
+            desc = _call_desc(call)
+            if desc is None:
+                continue
+            # the atomic-writer primitive is the seam's own delegate
+            # (same allowlist semantics as R3)
+            fn = None
+            for anc in ctx.ancestors(call):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    fn = anc.name
+                    break
+            if fn in _WRITER_FUNCS \
+                    or func_of_line.get(call.lineno) in _WRITER_FUNCS:
+                continue
+            out.append(self.finding(
+                ctx, call.lineno,
+                f"direct shared-dir I/O ({desc}) outside the "
+                "core/fsfault.py seam — route through fsfault."
+                "read_json/load_json/listdir/getsize/read_from/"
+                "glob_files so hardening AND the FAA_FSFAULT drills "
+                "cover this access (local-only files: justify with "
+                "`# robust: allow`)"))
+        return out
+
+
+def RULES() -> list[Rule]:
+    return [SharedDirIOSeamRule()]

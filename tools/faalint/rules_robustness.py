@@ -46,6 +46,7 @@ _R8_CLOCKS = {"time", "perf_counter"}
 # directly: THE atomic helpers themselves.
 ARTIFACT_WRITERS = {
     ("core/checkpoint.py", "save_checkpoint"),
+    ("core/fsfault.py", "write_json_atomic"),
     ("search/driver.py", "write_json_atomic"),
 }
 
