@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-compile bench-pipeline bench-fleet-search bench-control trace status clean reproduce chaos
+.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-serve-hotpath bench-compile bench-pipeline bench-fleet-search bench-control trace status clean reproduce chaos
 
 # telemetry journal dir for the trace/status targets (override:
 #   make trace TELEMETRY=/shared/run TRACE_OUT=overlap.json)
@@ -33,7 +33,7 @@ lint-selfcheck:
 # errors) — this is the gate the driver actually runs, with the
 # static-analysis gate as a preamble
 test-t1: lint
-	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # composed-fault chaos smoke (docs/RESILIENCE.md "Hostile shared
 # filesystem"): FAA_FAULT (a SIGKILLed actor) layered with FAA_FSFAULT
@@ -90,6 +90,16 @@ bench-overload:
 # (docs/SERVING.md "Measuring the plane")
 bench-router:
 	python tools/bench_router.py
+
+# serving data-plane hotpath bench: legacy (npz + fresh connections,
+# default replica) vs zerocopy (raw wire format + keep-alive pool,
+# --donate --double-buffer replica) as paired alternating rounds —
+# per-request HOST overhead from the replica's own
+# faa_serve_stage_seconds deltas, plus the 4-way bitwise gate (both
+# wire formats x both data planes serve identical bytes)
+# (docs/BENCHMARKS.md "Serving data plane")
+bench-serve-hotpath:
+	python tools/bench_serve_hotpath.py --out BENCH_r09_serve_hotpath.json
 
 # cold/warm compile-tax bench: the same train-step workload in two
 # fresh processes sharing one FAA_COMPILE_CACHE dir — the warm process

@@ -50,6 +50,7 @@ import functools
 import os
 import threading
 import time
+import warnings
 from typing import Any, Callable
 
 from fast_autoaugment_tpu.core import telemetry
@@ -263,7 +264,8 @@ def seam_jit(fn: Callable, *, label: str, **jit_kwargs: Any) -> Callable:
 
 
 def aot_compile(fn: Callable, *, label: str, example_args: tuple,
-                jit_kwargs: dict | None = None) -> tuple[Any, dict]:
+                jit_kwargs: dict | None = None,
+                donate_argnums: tuple | None = None) -> tuple[Any, dict]:
     """``jax.jit(fn).lower(*example_args).compile()`` through the seam.
 
     The ahead-of-time half of the seam (the serving path's executables,
@@ -273,12 +275,28 @@ def aot_compile(fn: Callable, *, label: str, example_args: tuple,
     ``jax.ShapeDtypeStruct`` specs.  Returns ``(compiled_executable,
     {"sec", "verdict"})``; with the persistent cache enabled and warm,
     the verdict is ``hit`` and `sec` is deserialization, not lowering.
+
+    `donate_argnums` compiles a DONATING executable: the named input
+    buffers alias the outputs, so the device never holds input and
+    output live at once — the zero-allocation serving dispatch
+    (docs/BENCHMARKS.md "Serving data plane").  A donated input must
+    never be read after dispatch; backends without donation support
+    (CPU) ignore the aliasing and stay bitwise-identical, which is
+    what lets the donation tests pin donated == undonated output.
     """
     import jax
 
+    kw = dict(jit_kwargs or {})
+    if donate_argnums is not None:
+        kw["donate_argnums"] = tuple(donate_argnums)
     h0, m0 = _snapshot()
     t0 = time.perf_counter()
-    compiled = jax.jit(fn, **(jit_kwargs or {})).lower(*example_args).compile()
+    with warnings.catch_warnings():
+        # CPU/backends without donation warn-and-ignore per executable;
+        # the fallback is part of the contract (bitwise tests), not news
+        warnings.filterwarnings(
+            "ignore", message=".*[Dd]onation.*not implemented.*")
+        compiled = jax.jit(fn, **kw).lower(*example_args).compile()
     sec = time.perf_counter() - t0
     verdict = _classify(h0, m0)
     _record(label, sec, verdict)

@@ -74,6 +74,11 @@ logger = get_logger("faa_tpu.serve")
 #: four-ish so padding waste stays < 4x at every load level
 DEFAULT_SHAPES = (1, 8, 32, 128)
 
+#: bucket schema for ``faa_serve_stage_seconds`` — the data-plane
+#: stages are µs-to-ms scale, far below DEFAULT_BUCKETS_SEC's 1ms floor
+_STAGE_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2,
+                  0.1, 0.5)
+
 #: per-process server index: labels each PolicyServer's registry
 #: counters so multiple instances (tests, embedders) never share counts
 _SERVER_SEQ = 0
@@ -110,6 +115,52 @@ def policy_digest(policy) -> str:
     return h.hexdigest()[:12]
 
 
+def _acc_stage(stages: dict | None, name: str, sec: float) -> None:
+    """Accumulate one stage wall into the caller's per-dispatch stage
+    dict (None = instrumentation off for this call)."""
+    if stages is not None:
+        stages[name] = stages.get(name, 0.0) + sec
+
+
+class _AsyncApply:
+    """An un-materialized :meth:`AotPolicyApplier.apply` result: every
+    chunk has been DISPATCHED (JAX async dispatch — device work is in
+    flight) but nothing has been copied back.  ``materialize()``
+    blocks on the device and assembles the ``[n, H, W, C]`` float32
+    output.  The double-buffered server holds one of these per
+    in-flight batch and materializes it only after the NEXT batch has
+    been staged and dispatched."""
+
+    __slots__ = ("n", "tail", "parts")
+
+    def __init__(self, n: int, tail: tuple, parts: list):
+        self.n = int(n)
+        self.tail = tuple(tail)
+        self.parts = parts  # [(padded_device_result, lo, hi), ...]
+
+    def materialize(self, stages: dict | None = None) -> np.ndarray:
+        t0 = mono()
+        out = np.empty((self.n,) + self.tail, np.float32)
+        for got, lo, hi in self.parts:
+            out[lo:hi] = np.asarray(got)[:hi - lo]
+        self.parts = []
+        _acc_stage(stages, "scatter", mono() - t0)
+        return out
+
+
+class _EagerApply:
+    """A pre-materialized result behind the :class:`_AsyncApply`
+    interface, for duck-typed appliers that expose only ``apply``."""
+
+    __slots__ = ("out",)
+
+    def __init__(self, out: np.ndarray):
+        self.out = out
+
+    def materialize(self, stages: dict | None = None) -> np.ndarray:
+        return self.out
+
+
 class AotPolicyApplier:
     """The learned policy as a set of AOT-compiled executables.
 
@@ -130,7 +181,8 @@ class AotPolicyApplier:
 
     def __init__(self, policy, *, image: int = 32, channels: int = 3,
                  shapes: Sequence[int] = DEFAULT_SHAPES,
-                 dispatch: str = "auto", groups: int = 8, watchdog=None):
+                 dispatch: str = "auto", groups: int = 8, watchdog=None,
+                 donate: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -177,6 +229,12 @@ class AotPolicyApplier:
                 return apply_policy_batch_grouped(
                     images, policy, key, groups=self.groups)
 
+        #: donated-buffer dispatch (opt-in): the executables alias
+        #: input onto output memory (``donate_argnums=(0,)``) and the
+        #: host pads into STANDING double-buffered staging arrays —
+        #: steady-state dispatch allocates nothing per request.  OFF =
+        #: the PR-7 bit-for-bit path (fresh pad allocation, undonated).
+        self.donate = bool(donate)
         #: per-shape compile evidence: {shape: {"sec", "verdict"}} — the
         #: bench stamps it next to the compile_cache block
         self.compile_log: dict[int, dict] = {}
@@ -191,16 +249,33 @@ class AotPolicyApplier:
                 spec_key = jax.ShapeDtypeStruct((2,), jnp.uint32)
             label = f"serve_{dispatch}_b{s}"
             self._exec[s], rec = aot_compile(  # robust: allow — startup-only: one AOT executable per padded batch shape, never in the dispatch path
-                kernel, label=label, example_args=(spec_img, spec_key))
+                kernel, label=label, example_args=(spec_img, spec_key),
+                donate_argnums=((0,) if self.donate else None))
             self.compile_log[s] = rec
             if watchdog is not None:
                 # AOT-loaded: the first dispatch is compile-free and
                 # must not hide behind the 600s compile window
                 watchdog.mark_compile_warm(label)
+        # two alternating host staging buffers per AOT shape: batch k+1
+        # pads into the slot batch k-1 used while k's donated buffer is
+        # still owned by the device — the writer never touches a buffer
+        # whose dispatch might still read it (double-buffer invariant,
+        # pinned by tests/test_serve_donation.py)
+        self._staging: dict[int, list] = {}
+        self._staging_keys: dict[int, list] = {}
+        self._slot = 0
+        if self.donate:
+            for s in self.shapes:
+                geom = (s, self.image, self.image, self.channels)
+                self._staging[s] = [np.zeros(geom, np.float32)
+                                    for _ in range(2)]
+                if dispatch == "exact":
+                    self._staging_keys[s] = [np.zeros((s, 2), np.uint32)
+                                             for _ in range(2)]
         logger.info(
             "AOT policy applier ready: %d sub-policies, dispatch=%s, "
-            "shapes=%s, compile %s",
-            self.num_sub, dispatch, list(self.shapes),
+            "shapes=%s, donate=%s, compile %s",
+            self.num_sub, dispatch, list(self.shapes), self.donate,
             {s: r["sec"] for s, r in self.compile_log.items()})
 
     def _pad(self, arr: np.ndarray, target: int) -> np.ndarray:
@@ -210,7 +285,29 @@ class AotPolicyApplier:
         return np.concatenate(
             [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
 
-    def apply(self, images: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    def _stage(self, images: np.ndarray, keys: np.ndarray | None,
+               s: int) -> tuple[np.ndarray, np.ndarray | None]:
+        """Pad into the next standing staging slot (donated path): copy
+        the batch in, ZERO the pad rows (a reused buffer must never
+        leak the previous batch into the padded lanes), flip the slot.
+        No allocation — ``np.copyto`` into preallocated arrays."""
+        n = images.shape[0]
+        slot = self._slot
+        self._slot = 1 - slot
+        buf = self._staging[s][slot]
+        np.copyto(buf[:n], images)
+        if n < s:
+            buf[n:] = 0.0
+        kbuf = None
+        if keys is not None:
+            kbuf = self._staging_keys[s][slot]
+            np.copyto(kbuf[:n], keys)
+            if n < s:
+                kbuf[n:] = 0
+        return buf, kbuf
+
+    def apply(self, images: np.ndarray, keys: np.ndarray,
+              stages: dict | None = None) -> np.ndarray:
         """Apply the policy to ``images [n, H, W, C]`` (uint8 or
         integral float32 in [0, 255]).
 
@@ -221,8 +318,20 @@ class AotPolicyApplier:
         dispatch.  Batches larger than the largest AOT shape are
         chunked; smaller ones pad up (zero images / zero keys in the
         padded lanes, results sliced away).  Returns float32
-        integral-valued images.
+        integral-valued images.  `stages` (optional dict) accumulates
+        per-stage walls (pad / h2d / dispatch / scatter seconds) for
+        the ``faa_serve_stage_seconds`` family.
         """
+        return self.apply_async(images, keys,
+                                stages=stages).materialize(stages=stages)
+
+    def apply_async(self, images: np.ndarray, keys: np.ndarray,
+                    stages: dict | None = None) -> "_AsyncApply":
+        """The pipelined half of :meth:`apply`: dispatches every chunk
+        to the device WITHOUT materializing results (JAX async
+        dispatch) and returns a handle whose ``materialize()`` blocks
+        and scatters.  The double-buffered server dispatches batch
+        k+1's chunks while batch k's handle is still computing."""
         images = np.asarray(images)
         if images.ndim != 4:
             raise ValueError(f"images must be [n, H, W, C], got "
@@ -234,8 +343,8 @@ class AotPolicyApplier:
                 f"{expect} — resize/crop client-side")
         images = images.astype(np.float32, copy=False)
         keys = np.asarray(keys, np.uint32)
-        out = np.empty_like(images)
         n = images.shape[0]
+        parts: list[tuple[object, int, int]] = []
         lo, chunk_idx = 0, 0
         while lo < n:
             hi = min(lo + self.max_batch, n)
@@ -250,24 +359,63 @@ class AotPolicyApplier:
 
                 k = np.asarray(jax.random.fold_in(keys, chunk_idx),
                                np.uint32)
-            out[lo:hi] = self._apply_one(images[lo:hi], k)
+            got = self._dispatch_one(images[lo:hi], k, stages)
+            if self.donate and n > self.max_batch:
+                # multi-chunk donated call: two staging slots only
+                # guarantee one overlap step, so chunk i+2 would reuse
+                # chunk i's slot while its H2D may still be in flight —
+                # force each chunk synchronous (the server never takes
+                # this path; its batches fit one chunk)
+                got = np.asarray(got)
+            parts.append((got, lo, hi))
             lo = hi
             chunk_idx += 1
-        return out
+        return _AsyncApply(n, images.shape[1:], parts)
 
-    def _apply_one(self, images: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    def _dispatch_one(self, images: np.ndarray, keys: np.ndarray,
+                      stages: dict | None = None):
+        """Pad + dispatch one chunk; returns the PADDED device result
+        (not materialized).  The donated path stages into the standing
+        double buffers and pays an explicit, timed H2D transfer; the
+        default path is the PR-7 allocation shape, bit for bit."""
         n = images.shape[0]
         s = pick_shape(self.shapes, n)
-        padded = self._pad(images, s)
+        t0 = mono()
         if self.dispatch == "exact":
-            keys = self._pad(np.asarray(keys, np.uint32).reshape(n, 2), s)
+            keys = np.asarray(keys, np.uint32).reshape(n, 2)
+        if self.donate:
+            padded, kp = self._stage(
+                images, keys if self.dispatch == "exact" else None, s)
+            if kp is not None:
+                keys = kp
+        else:
+            padded = self._pad(images, s)
+            if self.dispatch == "exact":
+                keys = self._pad(keys, s)
+        t1 = mono()
+        _acc_stage(stages, "pad", t1 - t0)
+        if self.donate:
+            # explicit H2D: the donated executable consumes a device
+            # buffer (aliased onto its output); staging stays host-side
+            # and reusable.  Timed as its own stage.
+            import jax
+
+            padded = jax.device_put(padded)
+            t2 = mono()
+            _acc_stage(stages, "h2d", t2 - t1)
+            t1 = t2
         fn = self._exec[s]
         label = f"serve_{self.dispatch}_b{s}"
         if self._watchdog is not None and self._watchdog.enabled:
             got = self._watchdog.run(label, fn, padded, keys)
         else:
             got = fn(padded, keys)
-        return np.asarray(got)[:n]
+        _acc_stage(stages, "dispatch", mono() - t1)
+        return got
+
+    def _apply_one(self, images: np.ndarray, keys: np.ndarray) -> np.ndarray:
+        n = images.shape[0]
+        return np.asarray(self._dispatch_one(images, keys))[:n]
 
     # ------------------------------------------------ export round-trip
 
@@ -527,6 +675,30 @@ class _Pending:
         return (mono() if now is None else now) >= self.deadline
 
 
+class _InflightBatch:
+    """A coalesced batch whose device work has been DISPATCHED but not
+    materialized (double-buffered mode): holds the request list, the
+    async handle, a strong applier reference (so eviction/reload can't
+    retire the executables mid-flight) and the per-stage walls so far.
+    The worker finalizes it after staging the NEXT batch."""
+
+    __slots__ = ("batch", "handle", "applier", "digest", "n_images",
+                 "t0", "stages", "images")
+
+    def __init__(self, batch, handle, applier, digest, n_images, t0,
+                 stages, images=None):
+        self.batch = batch
+        self.handle = handle
+        self.applier = applier
+        self.digest = digest
+        self.n_images = n_images
+        self.t0 = t0
+        self.stages = stages
+        # the concatenated input batch — kept ONLY when traffic_stats
+        # needs it at finalize time (None otherwise: no lifetime tax)
+        self.images = images
+
+
 class _RequestQueue:
     """Bounded request buffer with NON-BLOCKING admission and
     watermark-selected drain order.
@@ -674,7 +846,8 @@ class PolicyServer:
                  breaker_cooldown_s: float = 5.0,
                  dispatch_timeout_s: float = 0.0,
                  tenant_capacity: int = 0,
-                 traffic_stats: bool = False):
+                 traffic_stats: bool = False,
+                 double_buffer: bool = False):
         self.applier = applier
         self.max_batch = int(max_batch or applier.max_batch)
         if self.max_batch > applier.max_batch:
@@ -748,6 +921,14 @@ class PolicyServer:
         self._qdepth_gauge = reg.gauge(
             "faa_serve_queue_depth", "requests queued awaiting dispatch",
             server=self._server_id)
+        # double-buffered dispatch (opt-in): the worker overlaps batch
+        # k's device compute with batch k+1's collect/pad/dispatch —
+        # Podracer's keep-the-accelerator-fed lesson applied to the
+        # coalescer.  OFF = the strictly sequential PR-7 loop.
+        self.double_buffer = bool(double_buffer)
+        # per-stage data-plane overhead histograms (one child per
+        # stage label), registered lazily on first observation
+        self._stage_hist: dict[str, object] = {}
         #: grace past a request's deadline that result() still waits —
         #: covers the shed pass delivering the typed error
         self.deadline_grace_s = 1.0
@@ -1188,7 +1369,36 @@ class PolicyServer:
         return {"input_mean": round(m, 4), "input_std": round(s, 4),
                 "reward_proxy": round(proxy, 6)}
 
+    def observe_stage(self, stage: str, sec: float) -> None:
+        """One observation into the ``faa_serve_stage_seconds{stage=}``
+        family — the per-stage data-plane overhead breakdown
+        (queue_wait / pad / h2d / dispatch / scatter server-side;
+        decode / serialize from the HTTP front in serve_cli).
+        Children are registered lazily per stage label."""
+        h = self._stage_hist.get(stage)
+        if h is None:
+            h = telemetry.registry().histogram(
+                "faa_serve_stage_seconds",
+                "serving data-plane per-stage overhead (seconds; "
+                "docs/BENCHMARKS.md 'Serving data plane')",
+                buckets=_STAGE_BUCKETS, stage=stage,
+                server=self._server_id)
+            self._stage_hist[stage] = h
+        h.observe(sec)
+
     def _dispatch(self, batch: list[_Pending]) -> None:
+        """The strictly sequential dispatch (default mode): stage +
+        dispatch + materialize + scatter in one call."""
+        inf = self._dispatch_begin(batch)
+        if inf is not None:
+            self._dispatch_finish(inf)
+
+    def _dispatch_begin(self, batch: list[_Pending]) -> _InflightBatch | None:
+        """Bind the applier, pad/stage the batch and DISPATCH it to the
+        device without materializing (JAX async dispatch).  Returns the
+        in-flight handle, or None when the batch already failed (typed
+        error delivered).  Double-buffered mode finalizes the PREVIOUS
+        batch after this returns — batch k computes while k+1 stages."""
         # ONE applier per dispatch (the reload AND tenancy seam): the
         # binding is taken once here and holds a strong reference, so a
         # concurrent reload/eviction can never swap it mid-batch
@@ -1207,7 +1417,7 @@ class PolicyServer:
                     digest,
                     resident=(self._tenants.resident_digests()
                               if self._tenants else ())))
-                return
+                return None
         self._dispatch_attempts += 1
         if self.breaker.enabled and not self.breaker.allow():
             # open circuit: fail the whole batch fast — no device work
@@ -1218,7 +1428,9 @@ class PolicyServer:
             telemetry.emit("shed", f"serve{self._server_id}",
                            reason="breaker_open", n=len(batch))
             self._fail_batch(batch, err)
-            return
+            return None
+        stages: dict[str, float] = {
+            "queue_wait": mono() - batch[0].t_submit}
         images = np.concatenate([p.images for p in batch])
         images = self._injected_drift(images)
         if applier.dispatch == "exact":
@@ -1236,13 +1448,38 @@ class PolicyServer:
             if fault is not None and fault[0] == "slow":
                 base = self._wall_ema if self._wall_ema else 1.0
                 time.sleep(min(fault[1] * base, 300.0))
-            out = applier.apply(images, keys)
+            fn = getattr(applier, "apply_async", None)
+            if fn is not None:
+                handle = fn(images, keys, stages=stages)
+            else:
+                # duck-typed appliers (hot-reload stand-ins, tenancy
+                # dummies) expose only .apply — eager dispatch, wrapped
+                # so the finish path is uniform
+                handle = _EagerApply(applier.apply(images, keys))
         except Exception as e:  # noqa: BLE001 — delivered to every caller
             logger.error("serving dispatch failed (%d images): %s",
                          images.shape[0], e)
             self.breaker.record_failure()
             self._fail_batch(batch, e)
+            return None
+        return _InflightBatch(
+            batch, handle, applier, digest, int(images.shape[0]), t0,
+            stages, images=(images if self.traffic_stats else None))
+
+    def _dispatch_finish(self, inf: _InflightBatch) -> None:
+        """Materialize an in-flight batch and scatter results (FIFO);
+        all accounting — breaker verdict, counters, latency lists,
+        stage histograms, the dispatch journal event — lands here."""
+        batch, digest = inf.batch, inf.digest
+        try:
+            out = inf.handle.materialize(stages=inf.stages)
+        except Exception as e:  # noqa: BLE001 — delivered to every caller
+            logger.error("serving dispatch failed (%d images): %s",
+                         inf.n_images, e)
+            self.breaker.record_failure()
+            self._fail_batch(batch, e)
             return
+        t0 = inf.t0
         wall = mono() - t0
         if self.dispatch_timeout_s > 0 and wall > self.dispatch_timeout_s:
             # a straggler past the dispatch budget counts toward the
@@ -1254,8 +1491,9 @@ class PolicyServer:
             self.breaker.record_failure()
         else:
             self.breaker.record_success()
+        t_sc = mono()
         lo = 0
-        done = mono()
+        done = t_sc
         misses = 0
         for p in batch:
             p.result = out[lo:lo + p.n]
@@ -1265,45 +1503,74 @@ class PolicyServer:
                 misses += 1
             p.event.set()
             self._tenant_done(p)
+        _acc_stage(inf.stages, "scatter", mono() - t_sc)
         self._dispatches_ctr.inc()
         self._requests_ctr.inc(len(batch))
-        self._images_ctr.inc(int(images.shape[0]))
+        self._images_ctr.inc(inf.n_images)
         if digest is not None:
             t_reqs, t_imgs = self._tenant_counters(digest)
             t_reqs.inc(len(batch))
-            t_imgs.inc(int(images.shape[0]))
+            t_imgs.inc(inf.n_images)
         if misses:
             self._ctr["deadline_misses"].inc(misses)
         with self._lock:
-            self.batch_sizes.append(images.shape[0])
+            self.batch_sizes.append(inf.n_images)
             self.dispatch_walls.append(wall)
+        for stage, sec in inf.stages.items():
+            self.observe_stage(stage, sec)
         # served-traffic statistics ride the dispatch event (the drift
         # monitor's journal-derived signal); OFF = no new journal keys
-        traffic = (self._observe_traffic(images, out)
-                   if self.traffic_stats else {})
+        traffic = (self._observe_traffic(inf.images, out)
+                   if self.traffic_stats and inf.images is not None
+                   else {})
         # the serve arm of the span seam: same record shape as the
         # trainer/TTA dispatch windows (core/telemetry.py)
         telemetry.record_dispatch("serve_dispatch", t0, done,
-                                  batch=int(images.shape[0]),
+                                  batch=inf.n_images,
                                   requests=len(batch), **traffic)
         self._wall_ema = (wall if self._wall_ema is None
                           else 0.2 * wall + 0.8 * self._wall_ema)
 
     def _run(self) -> None:
+        # double-buffered mode: at most ONE batch is in flight on the
+        # device while the worker collects/stages the next.  The
+        # in-flight handle holds a strong applier reference, so the
+        # tenant sweep below stays safe at every boundary.
+        inflight: _InflightBatch | None = None
         while not self._stop.is_set():
             first = self._take_first()
             if first is None:
+                if inflight is not None:
+                    self._dispatch_finish(inflight)
+                    inflight = None
                 if self._closed.is_set():
                     break  # draining and the queue ran dry: done
                 continue
             batch = self._collect(first)
             if batch:
-                self._dispatch(batch)
+                if self.double_buffer:
+                    nxt = self._dispatch_begin(batch)
+                    if inflight is not None:
+                        # batch k+1 is dispatched; NOW block on batch k
+                        # — its device time overlapped k+1's collect,
+                        # pad and dispatch (the Podracer overlap)
+                        self._dispatch_finish(inflight)
+                    inflight = nxt
+                    if inflight is not None and self._carry is None \
+                            and self._q.empty():
+                        # nothing to overlap with: deliver immediately
+                        # rather than parking clients on the next poll
+                        self._dispatch_finish(inflight)
+                        inflight = None
+                else:
+                    self._dispatch(batch)
             if self._tenants is not None:
                 # the dispatch boundary: retiring tenants whose queued
                 # work has drained release their appliers HERE, never
                 # while a dispatch is in flight
                 self._tenants.sweep()
+        if inflight is not None:
+            self._dispatch_finish(inflight)
         # drain on stop: in-flight clients must not hang forever
         leftovers = [self._carry] if self._carry is not None else []
         self._carry = None
@@ -1401,6 +1668,12 @@ class PolicyServer:
         # the explicit resident-policy identity (the canary comparator
         # reads this name; default_digest stays as the PR-12 alias)
         out["policy_digest"] = self.default_digest
+        if getattr(self.applier, "donate", False) or self.double_buffer:
+            # zero-copy data-plane knobs (opt-in; absent = the
+            # historical PR-7 /stats surface, byte for byte)
+            out["data_plane"] = {
+                "donate": bool(getattr(self.applier, "donate", False)),
+                "double_buffer": self.double_buffer}
         if self.traffic_stats:
             out["traffic"] = {
                 "samples": self._traffic_samples,

@@ -53,16 +53,18 @@ docs/SERVING.md documents the plane end to end.
 from __future__ import annotations
 
 import hashlib
+import http.client
 import json
 import os
 import threading
 
 from fast_autoaugment_tpu.core import telemetry
 from fast_autoaugment_tpu.core.telemetry import mono, wall
+from fast_autoaugment_tpu.serve import wire
 from fast_autoaugment_tpu.utils.logging import get_logger
 
-__all__ = ["Replica", "Router", "rendezvous_order", "discover_replicas",
-           "parse_static_replicas"]
+__all__ = ["Replica", "Router", "BatchForwarder", "rendezvous_order",
+           "discover_replicas", "parse_static_replicas"]
 
 logger = get_logger("faa_tpu.router")
 
@@ -186,6 +188,8 @@ class Router:
                  readyz_timeout_s: float = 2.0,
                  upstream_timeout_s: float = 60.0,
                  failover_attempts: int = 2,
+                 batch_window_ms: float = 0.0,
+                 batch_max: int = 8,
                  name: str = "router"):
         if not port_dir and not static_replicas:
             raise ValueError("router needs --port-dir or a static "
@@ -198,6 +202,21 @@ class Router:
         self.upstream_timeout_s = float(upstream_timeout_s)
         self.failover_attempts = max(0, int(failover_attempts))
         self.name = str(name)
+        # keep-alive upstream plumbing: one pooled connection per
+        # (host, port) serves many forwarded requests — the per-request
+        # TCP setup tax was a measurable slice of routed latency.  The
+        # probe pool is separate so a slow data-plane exchange never
+        # holds up a health probe's short timeout.
+        self._pool = wire.ConnectionPool(timeout_s=self.upstream_timeout_s)
+        self._probe_pool = wire.ConnectionPool(
+            timeout_s=self.readyz_timeout_s, max_idle_per_key=1)
+        # opt-in pipelined forwarding: batch_window_ms > 0 coalesces
+        # concurrent /augment forwards per replica into ONE framed
+        # /augment_batch POST per flush (serve/wire.py frames)
+        self.batch_forwarder = (
+            BatchForwarder(self, window_ms=batch_window_ms,
+                           max_per_flush=batch_max)
+            if batch_window_ms > 0 else None)
         self._lock = threading.Lock()
         self._replicas: dict[str, Replica] = {}
         self._rr = 0                 # round-robin cursor (digest-less)
@@ -289,22 +308,16 @@ class Router:
     # -------------------------------------------------- health polling
 
     def _readyz_verdict(self, rep: Replica) -> tuple[bool, str]:
-        """One real readiness probe (no fault interference)."""
-        import http.client
-
+        """One real readiness probe (no fault interference) over the
+        keep-alive probe pool — steady-state polling reuses one
+        connection per replica instead of a TCP handshake per round."""
         try:
-            conn = http.client.HTTPConnection(
-                rep.host, rep.port, timeout=self.readyz_timeout_s)
-            try:
-                conn.request("GET", "/readyz")
-                resp = conn.getresponse()
-                resp.read()
-                if resp.status == 200:
-                    return True, "ok"
-                return False, f"readyz {resp.status}"
-            finally:
-                conn.close()
-        except OSError as e:
+            status, _, _ = self._probe_pool.request(
+                rep.host, rep.port, "GET", "/readyz")
+            if status == 200:
+                return True, "ok"
+            return False, f"readyz {status}"
+        except (OSError, http.client.HTTPException) as e:
             return False, f"unreachable: {type(e).__name__}"
 
     def _fault_victim_locked(self) -> str | None:
@@ -398,6 +411,8 @@ class Router:
             # bounded join (lint R6): a wedged probe must not hang
             # shutdown — the poller is a daemon either way
             self._poll_thread.join(timeout=timeout)
+        self._pool.close_all()
+        self._probe_pool.close_all()
 
     # ---------------------------------------------------- canary split
 
@@ -499,19 +514,33 @@ class Router:
 
     def _upstream(self, rep: Replica, method: str, path: str,
                   body: bytes | None, headers: dict) -> tuple:
-        """One upstream attempt; returns (status, resp_headers, body)
-        or raises OSError on a transport failure."""
-        import http.client
+        """One upstream attempt over the keep-alive pool; returns
+        (status, resp_headers, body) or raises OSError /
+        http.client.HTTPException on a transport failure.  A stale
+        pooled connection is retried once on a fresh socket inside the
+        pool (serve/wire.py ConnectionPool)."""
+        return self._pool.request(rep.host, rep.port, method, path,
+                                  body=body or b"", headers=headers)
 
-        conn = http.client.HTTPConnection(
-            rep.host, rep.port, timeout=self.upstream_timeout_s)
-        try:
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            return resp.status, dict(resp.getheaders()), data
-        finally:
-            conn.close()
+    def _upstream_tag(self, tag: str, method: str, path: str,
+                      body: bytes | None, headers: dict) -> tuple:
+        """One upstream attempt pinned to a replica TAG (the batched
+        flush targets the lane's replica directly — failover for a
+        failed flush happens per entry through :meth:`forward`)."""
+        with self._lock:
+            rep = self._replicas.get(tag)
+        if rep is None:
+            raise OSError(f"replica {tag} left the table")
+        return self._upstream(rep, method, path, body, headers)
+
+    def forward_augment(self, body: bytes | None, headers: dict,
+                        digest: str | None) -> tuple:
+        """Route one /augment request through the batched forwarder
+        when one is armed (``batch_window_ms > 0``), else directly —
+        the handler's single entry point for data-plane traffic."""
+        if self.batch_forwarder is not None:
+            return self.batch_forwarder.submit(body, headers, digest)
+        return self.forward("POST", "/augment", body, headers, digest)
 
     def forward(self, method: str, path: str, body: bytes | None,
                 headers: dict, digest: str | None) -> tuple:
@@ -533,7 +562,7 @@ class Router:
             try:
                 status, rheaders, data = self._upstream(
                     rep, method, path, body, headers)
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
                 logger.warning("router: upstream %s failed: %s",
                                rep.tag, e)
                 last = (502, {}, json.dumps(
@@ -590,6 +619,9 @@ class Router:
         return {
             "router": self.name,
             "port_dir": self.port_dir,
+            "connections": self._pool.stats(),
+            "batch_forwarding": (None if self.batch_forwarder is None
+                                 else self.batch_forwarder.stats()),
             "replicas": reps,
             "in_rotation": sorted(t for t, r in reps.items()
                                   if r["in_rotation"]),
@@ -612,6 +644,156 @@ class Router:
                            for a, c in self._canary_ctr.items()},
             },
         }
+
+
+class _BatchEntry:
+    """One /augment request parked in a forwarding lane."""
+
+    __slots__ = ("meta", "body", "headers", "digest", "event", "result")
+
+    def __init__(self, meta: dict, body: bytes, headers: dict,
+                 digest: str | None):
+        self.meta = meta
+        self.body = body
+        self.headers = headers
+        self.digest = digest
+        self.event = threading.Event()
+        self.result: tuple | None = None
+
+
+class BatchForwarder:
+    """Opt-in pipelined router forwarding: concurrent /augment
+    requests headed for the SAME replica coalesce into one framed
+    ``/augment_batch`` POST per flush (serve/wire.py frames) instead
+    of N singleton POSTs.
+
+    Leader-per-flush model: the first request to open a replica's lane
+    becomes the flush leader — it waits ``window_ms`` for followers to
+    pile on, drains the lane, ships the frame payload and distributes
+    the per-part answers; followers just park on their entry's event.
+    A single-entry flush degenerates to the normal :meth:`Router.
+    forward` path (no frame overhead), and a FAILED flush falls back
+    per entry through the same path, so batched forwarding inherits
+    the bounded-failover/backoff semantics instead of reimplementing
+    them.  Sub-request ordering within a flush is preserved; replies
+    pass through per entry (a part-level 429/503 reaches its own
+    client, not the whole batch)."""
+
+    def __init__(self, router: Router, window_ms: float = 2.0,
+                 max_per_flush: int = 8):
+        self.router = router
+        self.window_s = max(0.0, float(window_ms)) / 1e3
+        self.max_per_flush = max(1, int(max_per_flush))
+        self._lock = threading.Lock()
+        self._lanes: dict[str, list[_BatchEntry]] = {}
+        reg = telemetry.registry()
+        self._flush_ctr = reg.counter(
+            "faa_router_batch_flushes_total",
+            "framed multi-request flushes shipped upstream",
+            router=router.name)
+        self._entries_ctr = reg.counter(
+            "faa_router_batch_entries_total",
+            "requests forwarded through the batched lane",
+            router=router.name)
+        self._fallback_ctr = reg.counter(
+            "faa_router_batch_fallbacks_total",
+            "entries that fell back to singleton forwarding after a "
+            "failed flush", router=router.name)
+
+    def submit(self, body: bytes, headers: dict,
+               digest: str | None) -> tuple:
+        """Forward one /augment request through its replica's lane;
+        blocks until the answer is in (the handler thread IS the
+        client's connection)."""
+        cands, _ = self.router.candidates(digest)
+        if not cands:
+            # no rotation: the direct path owns the structured 503
+            return self.router.forward("POST", "/augment", body,
+                                       headers, digest)
+        tag = cands[0].tag
+        meta = {"ctype": headers.get("Content-Type", "")}
+        if headers.get("X-FAA-Deadline-Ms") is not None:
+            meta["deadline_ms"] = float(headers["X-FAA-Deadline-Ms"])
+        if digest is not None:
+            meta["digest"] = digest
+        entry = _BatchEntry(meta, body, headers, digest)
+        self._entries_ctr.inc()
+        with self._lock:
+            lane = self._lanes.get(tag)
+            leader = lane is None
+            if leader:
+                lane = []
+                self._lanes[tag] = lane
+            lane.append(entry)
+        if not leader:
+            # bounded park (lint R6): window + upstream budget + grace
+            if not entry.event.wait(timeout=self.window_s
+                                    + self.router.upstream_timeout_s
+                                    + 5.0):
+                return (502, {}, json.dumps(
+                    {"error": "batched flush timed out",
+                     "type": "router_batch_timeout"}).encode(), None)
+            return entry.result
+        # leader: hold the lane open one window (stop-aware so a
+        # draining router flushes immediately), then drain and ship
+        self.router._stop.wait(self.window_s)
+        with self._lock:
+            batch = self._lanes.pop(tag, [])
+        for lo in range(0, len(batch), self.max_per_flush):
+            self._flush(tag, batch[lo:lo + self.max_per_flush])
+        return entry.result
+
+    def _flush(self, tag: str, chunk: list[_BatchEntry]) -> None:
+        if len(chunk) == 1:
+            e = chunk[0]
+            e.result = self.router.forward("POST", "/augment", e.body,
+                                           e.headers, e.digest)
+            e.event.set()
+            return
+        payload = wire.encode_frames([(e.meta, e.body) for e in chunk])
+        try:
+            status, _, data = self.router._upstream_tag(
+                tag, "POST", "/augment_batch", payload,
+                {"Content-Type": wire.FRAME_CONTENT_TYPE,
+                 "Content-Length": str(len(payload))})
+            parts = (wire.decode_frames(data) if status == 200 else None)
+            if parts is not None and len(parts) != len(chunk):
+                raise ValueError(
+                    f"flush answered {len(parts)} parts for "
+                    f"{len(chunk)} entries")
+        except (OSError, http.client.HTTPException, ValueError) as exc:
+            logger.warning("router: batched flush to %s failed (%s) — "
+                           "falling back per entry", tag, exc)
+            parts = None
+        if parts is None:
+            # the singleton path re-routes with failover/backoff
+            for e in chunk:
+                self._fallback_ctr.inc()
+                e.result = self.router.forward("POST", "/augment",
+                                               e.body, e.headers,
+                                               e.digest)
+                e.event.set()
+            return
+        self._flush_ctr.inc()
+        with self.router._lock:
+            # the fault coordinate counts every routed request, the
+            # batched lane included (forward() does it for fallbacks)
+            self.router._requests_routed += len(chunk)
+        for e, (meta, pbody) in zip(chunk, parts):
+            rheaders = dict(meta.get("headers") or {})
+            rheaders["Content-Type"] = meta.get(
+                "ctype", "application/octet-stream")
+            e.result = (int(meta.get("status", 500)), rheaders,
+                        bytes(pbody), tag)
+            self.router._count_routed(tag, tag, 0)
+            e.event.set()
+
+    def stats(self) -> dict:
+        return {"window_ms": self.window_s * 1e3,
+                "max_per_flush": self.max_per_flush,
+                "entries": int(self._entries_ctr.value),
+                "flushes": int(self._flush_ctr.value),
+                "fallbacks": int(self._fallback_ctr.value)}
 
 
 def _retry_after_s(headers: dict) -> float:

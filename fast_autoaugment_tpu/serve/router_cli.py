@@ -12,7 +12,13 @@ rendezvous-hashes to (``serve/router.py``):
   ``X-FAA-Deadline-Ms`` pass through; upstream 429/503 answers mark
   the replica backing off per its ``Retry-After`` and fail over
   (bounded by ``--failover-attempts``); with no replica in rotation
-  the router itself answers a structured 503.
+  the router itself answers a structured 503.  ``--batch-window-ms``
+  arms pipelined forwarding: concurrent requests for the same replica
+  coalesce into one framed ``/augment_batch`` POST per flush
+  (serve/wire.py).  Both hops are keep-alive — persistent client
+  connections in, a pooled upstream connection per replica out.
+- ``POST /augment_batch`` — client-assembled frame payloads pass
+  through to the routed replica unchanged.
 - ``POST /canary`` — the control plane's canary-split admin
   (``{"digest": D, "replicas": [tags], "every": N}`` arms it,
   ``{"clear": true}`` clears it): canary-digest traffic steers to the
@@ -59,6 +65,16 @@ def make_router_handler(router: Router,
                         max_body_bytes: int =
                         DEFAULT_MAX_BODY_MB * 1024 * 1024):
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 keep-alive on the client leg too: the router's
+        # upstream leg already pools connections (serve/wire.py), so a
+        # persistent client sees zero TCP setup on either hop
+        protocol_version = "HTTP/1.1"
+        timeout = 60  # reap idle keep-alive connections
+        # persistent connections leave Linux's initial TCP quickack
+        # mode; without TCP_NODELAY the headers/body write pair then
+        # hits Nagle + delayed-ACK (~40ms per response)
+        disable_nagle_algorithm = True
+
         def log_message(self, fmt, *args):
             logger.info("http: " + fmt, *args)
 
@@ -99,6 +115,13 @@ def make_router_handler(router: Router,
             self._send_json(404, {"error": f"unknown path {self.path}",
                                   "type": "unknown_path"})
 
+        def _refuse(self, code: int, obj: dict) -> None:
+            """Refuse a request whose body was never read: under
+            HTTP/1.1 keep-alive the unread bytes would poison the next
+            request on this connection, so the refusal closes it."""
+            self.close_connection = True
+            self._send_json(code, obj, {"Connection": "close"})
+
         def _do_canary(self):
             """``POST /canary`` — the control plane's split admin
             (docs/CONTROL.md): body ``{"digest": D, "replicas": [tags],
@@ -106,6 +129,18 @@ def make_router_handler(router: Router,
             it.  Answers the router's canary stats block."""
             try:
                 length = int(self.headers.get("Content-Length", "0") or 0)
+            except ValueError:
+                self._refuse(400, {"error": "malformed Content-Length",
+                                   "type": "bad_request"})
+                return
+            if length > max_body_bytes:
+                # bound admin bodies like data-plane ones: refuse on
+                # Content-Length BEFORE buffering anything
+                self._refuse(413, {"error": f"canary body of {length} "
+                                            "bytes refused",
+                                   "type": "body_too_large"})
+                return
+            try:
                 req = json.loads(self.rfile.read(length) or b"{}") \
                     if length > 0 else {}
                 if not isinstance(req, dict):
@@ -127,7 +162,7 @@ def make_router_handler(router: Router,
                 if self.path == "/canary":
                     self._do_canary()
                     return
-                if self.path != "/augment":
+                if self.path not in ("/augment", "/augment_batch"):
                     self._send_json(404,
                                     {"error": f"unknown path {self.path}",
                                      "type": "unknown_path"})
@@ -135,12 +170,12 @@ def make_router_handler(router: Router,
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                 except ValueError:
-                    self._send_json(400, {"error": "malformed "
-                                          "Content-Length",
-                                          "type": "bad_request"})
+                    self._refuse(400, {"error": "malformed "
+                                       "Content-Length",
+                                       "type": "bad_request"})
                     return
                 if length <= 0 or length > max_body_bytes:
-                    self._send_json(
+                    self._refuse(
                         413 if length > max_body_bytes else 400,
                         {"error": f"body of {length} bytes refused",
                          "type": ("body_too_large"
@@ -155,8 +190,15 @@ def make_router_handler(router: Router,
                     if val is not None:
                         fwd_headers[name] = val
                 digest = self.headers.get(DIGEST_HEADER)
-                status, rheaders, data, routed = router.forward(
-                    "POST", self.path, body, fwd_headers, digest)
+                if self.path == "/augment":
+                    # the batched lane when --batch-window-ms armed it,
+                    # the direct failover path otherwise
+                    status, rheaders, data, routed = \
+                        router.forward_augment(body, fwd_headers, digest)
+                else:
+                    # a client-assembled frame payload passes through
+                    status, rheaders, data, routed = router.forward(
+                        "POST", self.path, body, fwd_headers, digest)
                 out_headers = {}
                 for k, v in rheaders.items():
                     if k.lower() in ("retry-after",):
@@ -215,6 +257,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "primary on 429/503/transport failure (bounded "
                         "failover); Retry-After answers also put the "
                         "rejecting replica in a routing backoff window")
+    p.add_argument("--batch-window-ms", type=float, default=0.0,
+                   help="pipelined forwarding: concurrent /augment "
+                        "requests headed for the same replica coalesce "
+                        "for this window into ONE framed /augment_batch "
+                        "POST per flush (0 = off, singleton forwarding)")
+    p.add_argument("--batch-max", type=int, default=8,
+                   help="max sub-requests per batched flush")
     p.add_argument("--max-body-mb", type=int, default=DEFAULT_MAX_BODY_MB)
     p.add_argument("--telemetry", default="off", metavar="{off,DIR}",
                    help="flight-recorder journal dir: rotation events "
@@ -236,7 +285,9 @@ def main(argv=None):
         readmit_after=args.readmit_after,
         readyz_timeout_s=args.readyz_timeout,
         upstream_timeout_s=args.upstream_timeout,
-        failover_attempts=args.failover_attempts).start()
+        failover_attempts=args.failover_attempts,
+        batch_window_ms=args.batch_window_ms,
+        batch_max=args.batch_max).start()
     httpd = _RouterHTTPServer(
         (args.host, args.port),
         make_router_handler(router,

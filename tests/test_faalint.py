@@ -423,6 +423,72 @@ def test_d3_corpus_shapes():
     assert not check_source(src, TRAIN)
 
 
+# ------------------------------------------------------------------- D4
+
+
+SERVE = "fast_autoaugment_tpu/serve/x.py"
+
+_D4_POS = ("import io\n"
+           "import numpy as np\n"
+           "def _do_augment(self, server, body):\n"
+           "    payload = np.load(io.BytesIO(body), allow_pickle=False)\n"
+           "    return server.submit(payload['images'])\n")
+
+
+def test_d4_np_load_in_request_handler_serve_scope_only():
+    assert _rules(check_source(_D4_POS, SERVE)) == ["D4"]
+    # train/ request-ish names are not a serving hot path
+    assert "D4" not in _rules(check_source(_D4_POS, TRAIN))
+
+
+def test_d4_handler_class_helper_methods_are_hot_path():
+    src = ("import numpy as np\n"
+           "class MyHandler:\n"
+           "    def _parse_images(self, body):\n"
+           "        return np.array(body)\n")
+    assert _rules(check_source(src, SERVE)) == ["D4"]
+
+
+def test_d4_tobytes_and_savez_flagged():
+    src = ("import io\n"
+           "import numpy as np\n"
+           "def do_POST(self, out):\n"
+           "    buf = io.BytesIO()\n"
+           "    np.savez(buf, images=out)\n"
+           "    return out.tobytes()\n")
+    assert _rules(check_source(src, SERVE)) == ["D4", "D4"]
+
+
+def test_d4_np_array_copy_false_is_a_view_not_flagged():
+    src = ("import numpy as np\n"
+           "def _do_augment(self, body):\n"
+           "    return np.array(body, copy=False)\n")
+    assert not check_source(src, SERVE)
+
+
+def test_d4_non_handler_functions_exempt():
+    # encode/decode helpers OUTSIDE a handler (wire.py's client-side
+    # encoders legitimately materialize bytes)
+    src = ("import numpy as np\n"
+           "def encode_raw(images):\n"
+           "    return images.tobytes()\n")
+    assert not check_source(src, SERVE)
+
+
+def test_d4_robust_allow_marks_the_legacy_npz_lane():
+    src = _D4_POS.replace(
+        "payload = np.load(io.BytesIO(body), allow_pickle=False)",
+        "payload = np.load(io.BytesIO(body), allow_pickle=False)"
+        "  # robust: allow — legacy npz lane")
+    assert not check_source(src, SERVE)
+
+
+def test_d4_corpus_case_registered():
+    assert "npz_per_request" in CASES
+    relpath, expected, pass_name = CASES["npz_per_request"]
+    assert expected == {"D4"} and pass_name == "dispatch"
+
+
 # -------------------------------------------------------------- T1/T2/T3
 
 

@@ -1,0 +1,345 @@
+"""The zero-copy serving wire layer (fast_autoaugment_tpu/serve/wire.py)
+and its HTTP integration (serve_cli raw/frames/shm lanes, keep-alive).
+
+Fast half: pure codec/pool contracts — raw-format roundtrips are
+zero-copy views, the arena recycles buffers, frames pack/unpack, the
+connection pool reuses sockets and survives a stale keep-alive.  Slow
+half: through a live ``make_handler`` server — the raw format serves
+the SAME BYTES as the legacy npz format, the batch endpoint scatters
+per-part responses, the shm lane round-trips without image bytes on
+the socket, and an oversized Content-Length is refused BEFORE the body
+is buffered (with the connection closed so the keep-alive stream can't
+desync).
+"""
+
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from fast_autoaugment_tpu.serve import wire
+
+# ----------------------------------------------------------- raw codec
+
+
+def test_raw_roundtrip_uint8_and_float32():
+    for dtype in (np.uint8, np.float32):
+        imgs = (np.arange(2 * 4 * 4 * 3) % 256).reshape(2, 4, 4, 3) \
+            .astype(dtype)
+        body = wire.encode_raw(imgs)
+        got, seeds = wire.decode_raw(body)
+        assert seeds is None
+        assert got.dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(got, imgs)
+
+
+def test_raw_roundtrip_with_seeds():
+    imgs = np.zeros((3, 2, 2, 3), np.float32)
+    keys = np.arange(6, dtype=np.uint32).reshape(3, 2)
+    got, got_keys = wire.decode_raw(wire.encode_raw(imgs, seeds=keys))
+    np.testing.assert_array_equal(got_keys, keys)
+    np.testing.assert_array_equal(got, imgs)
+
+
+def test_raw_decode_is_zero_copy_view():
+    imgs = np.ones((2, 4, 4, 3), np.float32)
+    body = wire.encode_raw(imgs)
+    got, _ = wire.decode_raw(body)
+    assert np.shares_memory(got, np.frombuffer(body, np.uint8))
+
+
+def test_raw_decode_rejects_bad_payloads():
+    imgs = np.ones((1, 2, 2, 3), np.uint8)
+    ok = wire.encode_raw(imgs)
+    with pytest.raises(ValueError, match="magic"):
+        wire.decode_raw(b"NOPE!\n" + ok[len(wire.RAW_MAGIC):])
+    with pytest.raises(ValueError, match="truncated"):
+        wire.decode_raw(ok[:-4])
+    evil = wire.RAW_MAGIC + json.dumps(
+        {"dtype": "object", "shape": [1, 2, 2, 3], "seeds": 0}).encode() \
+        + b"\n" + b"\x00" * 64
+    with pytest.raises(ValueError, match="dtype"):
+        wire.decode_raw(evil)
+    evil = wire.RAW_MAGIC + json.dumps(
+        {"dtype": "uint8", "shape": [2, 2], "seeds": 0}).encode() \
+        + b"\n" + b"\x00" * 64
+    with pytest.raises(ValueError, match="shape"):
+        wire.decode_raw(evil)
+
+
+def test_encode_raw_into_matches_encode_raw_with_fused_cast():
+    arena = wire.BufferArena()
+    out = np.linspace(0, 255, 2 * 3 * 3 * 3, dtype=np.float32) \
+        .reshape(2, 3, 3, 3)
+    view, lease = wire.encode_raw_into(arena, out, as_dtype=np.uint8)
+    want = wire.encode_raw(out.astype(np.uint8))
+    assert bytes(view) == want
+    view = None  # release the memoryview before the lease goes back
+    arena.checkin(lease)
+
+
+# --------------------------------------------------------------- arena
+
+
+def test_arena_recycles_buffers():
+    arena = wire.BufferArena()
+    a = arena.checkout(1000)
+    arena.checkin(a)
+    b = arena.checkout(900)  # same power-of-two class
+    assert b is a
+    assert arena.stats()["hits"] == 1
+
+
+def test_arena_is_bounded_per_class():
+    arena = wire.BufferArena(max_per_class=1)
+    a, b = arena.checkout(100), arena.checkout(100)
+    arena.checkin(a)
+    arena.checkin(b)  # over the bound: dropped, not pooled
+    assert arena.stats()["pooled"] == 1
+
+
+# -------------------------------------------------------------- frames
+
+
+def test_frames_roundtrip():
+    parts = [({"ctype": "a"}, b"hello"), ({"status": 200}, b""),
+             ({"k": 1}, b"\x00\x01\x02")]
+    got = wire.decode_frames(wire.encode_frames(parts))
+    assert [(m, bytes(b)) for m, b in got] \
+        == [(m, b) for m, b in parts]
+
+
+def test_frames_reject_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        wire.decode_frames(b"whatever")
+    ok = wire.encode_frames([({}, b"abcdef")])
+    with pytest.raises(ValueError, match="truncated"):
+        wire.decode_frames(ok[:-3])
+
+
+# ----------------------------------------------------------- shm codec
+
+
+def test_shm_descriptor_roundtrip():
+    body = wire.encode_shm_request("psm_x", "float32", (2, 4, 4, 3),
+                                   seeds=np.arange(4).reshape(2, 2))
+    name, dtype, shape, seeds = wire.decode_shm_request(body)
+    assert (name, dtype, shape) == ("psm_x", np.float32, (2, 4, 4, 3))
+    np.testing.assert_array_equal(
+        seeds, np.arange(4, dtype=np.uint32).reshape(2, 2))
+    with pytest.raises(ValueError, match="dtype"):
+        wire.decode_shm_request(wire.encode_shm_request(
+            "x", "complex128", (1, 2, 2, 3)))
+
+
+# ------------------------------------------------------ connection pool
+
+
+def _tiny_server():
+    """Minimal HTTP/1.1 keep-alive server for pool tests."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd
+
+
+def test_pool_reuses_connections_and_sets_nodelay():
+    import socket
+
+    httpd = _tiny_server()
+    try:
+        port = httpd.server_address[1]
+        pool = wire.ConnectionPool(timeout_s=10.0)
+        for _ in range(3):
+            status, _h, body = pool.request("127.0.0.1", port, "GET", "/")
+            assert (status, body) == (200, b"ok")
+        st = pool.stats()
+        assert st["opens"] == 1 and st["reuses"] == 2
+        conn = pool._idle[("127.0.0.1", port)][0]
+        assert conn.sock.getsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY) != 0
+        pool.close_all()
+        assert pool.stats()["idle"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_pool_retries_stale_keepalive_once():
+    httpd = _tiny_server()
+    try:
+        port = httpd.server_address[1]
+        pool = wire.ConnectionPool(timeout_s=10.0)
+        assert pool.request("127.0.0.1", port, "GET", "/")[0] == 200
+        # sever the pooled socket behind the pool's back — the next
+        # request must transparently retry on a fresh connection
+        pool._idle[("127.0.0.1", port)][0].sock.close()
+        status, _h, body = pool.request("127.0.0.1", port, "GET", "/")
+        assert (status, body) == (200, b"ok")
+        assert pool.stats()["opens"] == 2
+        pool.close_all()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------- HTTP integration (slow)
+
+
+IMG = 8
+SINGLE_SUB = np.array([[[4, 0.8, 0.7], [10, 0.5, 0.3]]], np.float32)
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    """One module-scoped serve_cli handler stack over a real
+    PolicyServer (shm lane armed, small body cap) — shared so the AOT
+    compile is paid once."""
+    from http.server import ThreadingHTTPServer
+
+    from fast_autoaugment_tpu.serve.policy_server import (
+        AotPolicyApplier,
+        PolicyServer,
+    )
+    from fast_autoaugment_tpu.serve.serve_cli import make_handler
+
+    applier = AotPolicyApplier(SINGLE_SUB, image=IMG, shapes=(4,))
+    srv = PolicyServer(applier, max_wait_ms=2).start()
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        make_handler(srv, applier, max_body_bytes=256 * 1024,
+                     shm_ingest=True))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd.server_address[1], applier
+    httpd.shutdown()
+    httpd.server_close()
+    srv.stop()
+
+
+def _seeded_bodies(n=3):
+    import jax
+
+    rng = np.random.default_rng(3)
+    imgs = rng.integers(0, 256, (n, IMG, IMG, 3), dtype=np.uint8)
+    seeds = np.arange(n)
+    keys = np.asarray(jax.vmap(jax.random.PRNGKey)(
+        np.asarray(seeds, np.int64) & 0x7FFFFFFF), np.uint32)
+    buf = io.BytesIO()
+    np.savez(buf, images=imgs, seeds=seeds)
+    return imgs, keys, buf.getvalue(), wire.encode_raw(imgs, seeds=keys)
+
+
+@pytest.mark.slow
+def test_raw_and_npz_serve_identical_bytes(live_server):
+    port, _applier = live_server
+    _imgs, _keys, npz_body, raw_body = _seeded_bodies()
+    pool = wire.ConnectionPool(timeout_s=60.0)
+    try:
+        s1, h1, npz_resp = pool.request(
+            "127.0.0.1", port, "POST", "/augment", npz_body,
+            {"Content-Type": "application/octet-stream"})
+        s2, h2, raw_resp = pool.request(
+            "127.0.0.1", port, "POST", "/augment", raw_body,
+            {"Content-Type": wire.RAW_CONTENT_TYPE})
+        assert s1 == 200 and s2 == 200
+        assert h2["Content-Type"] == wire.RAW_CONTENT_TYPE
+        npz_imgs = np.load(io.BytesIO(npz_resp))["images"]
+        raw_imgs, _ = wire.decode_raw(raw_resp)
+        assert raw_imgs.dtype == np.uint8
+        np.testing.assert_array_equal(np.asarray(raw_imgs), npz_imgs)
+        # the whole exchange rode ONE keep-alive connection
+        assert pool.stats()["opens"] == 1
+    finally:
+        pool.close_all()
+
+
+@pytest.mark.slow
+def test_batch_endpoint_scatters_per_part(live_server):
+    port, _applier = live_server
+    _imgs, _keys, npz_body, raw_body = _seeded_bodies()
+    frames = wire.encode_frames([
+        ({"ctype": wire.RAW_CONTENT_TYPE}, raw_body),
+        ({"ctype": "application/octet-stream"}, npz_body),
+    ])
+    pool = wire.ConnectionPool(timeout_s=60.0)
+    try:
+        status, headers, resp = pool.request(
+            "127.0.0.1", port, "POST", "/augment_batch", frames,
+            {"Content-Type": wire.FRAME_CONTENT_TYPE})
+        assert status == 200
+        assert headers["Content-Type"] == wire.FRAME_CONTENT_TYPE
+        parts = wire.decode_frames(resp)
+        assert len(parts) == 2
+        assert all(m["status"] == 200 for m, _ in parts)
+        raw_imgs, _ = wire.decode_raw(bytes(parts[0][1]))
+        npz_imgs = np.load(io.BytesIO(bytes(parts[1][1])))["images"]
+        np.testing.assert_array_equal(np.asarray(raw_imgs), npz_imgs)
+    finally:
+        pool.close_all()
+
+
+@pytest.mark.slow
+def test_shm_lane_roundtrip(live_server):
+    port, _applier = live_server
+    imgs, keys, npz_body, _raw = _seeded_bodies()
+    region = wire.ShmRegion((imgs.shape[0], IMG, IMG, 3), np.float32)
+    pool = wire.ConnectionPool(timeout_s=60.0)
+    try:
+        region.write(imgs.astype(np.float32))
+        status, _h, resp = pool.request(
+            "127.0.0.1", port, "POST", "/augment",
+            region.request_body(seeds=keys),
+            {"Content-Type": wire.SHM_CONTENT_TYPE})
+        assert status == 200, resp
+        echo = json.loads(resp)
+        assert echo["shm"] == region.name
+        got = region.read_result()
+        # same bytes as the npz lane for the same seeded batch
+        s2, _h2, npz_resp = pool.request(
+            "127.0.0.1", port, "POST", "/augment", npz_body,
+            {"Content-Type": "application/octet-stream"})
+        assert s2 == 200
+        np.testing.assert_array_equal(
+            got, np.load(io.BytesIO(npz_resp))["images"])
+    finally:
+        pool.close_all()
+        region.close()
+
+
+@pytest.mark.slow
+def test_oversized_body_refused_before_read(live_server):
+    import http.client
+
+    port, _applier = live_server
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        # declare a body far over the cap but never send it: the 413
+        # must arrive up front (pre-buffering) and close the connection
+        conn.putrequest("POST", "/augment")
+        conn.putheader("Content-Length", str(512 * 1024 * 1024))
+        conn.putheader("Content-Type", "application/octet-stream")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        assert json.loads(resp.read())["type"] == "body_too_large"
+        assert resp.getheader("Connection") == "close"
+    finally:
+        conn.close()

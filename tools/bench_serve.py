@@ -134,7 +134,15 @@ def _robustness_stamp(stats: dict) -> dict:
     them alongside latency)."""
     adm = stats.get("admission", {})
     brk = stats.get("breaker", {})
+    # mean coalesced batch over DISPATCHED work only: images_served /
+    # dispatches counts requests the coalescer actually batched — shed
+    # and expired requests never reach a dispatch, so an offered-load
+    # denominator would understate batch efficiency under overload
+    disp = stats.get("dispatches", 0)
+    mean_coalesced = (round(stats.get("images_served", 0) / disp, 2)
+                      if disp else None)
     return {
+        "mean_coalesced_batch": mean_coalesced,
         "admitted": adm.get("admitted", 0),
         "shed_overload": adm.get("shed_overload", 0),
         "shed_breaker": adm.get("shed_breaker", 0),
@@ -181,6 +189,8 @@ def run_router_load(router_url: str, images_pool, seconds: float,
 
     import numpy as np
 
+    from fast_autoaugment_tpu.serve import wire
+
     host, port = _parse_addr(router_url)
     buf = io.BytesIO()
     np.savez(buf, images=images_pool[:imgs_per_request].astype(np.uint8))
@@ -189,6 +199,10 @@ def run_router_load(router_url: str, images_pool, seconds: float,
     lats: list[float] = []
     outcomes = {"ok": 0, "retried": 0, "failed": 0}
     stop_at = time.perf_counter() + seconds
+    # keep-alive clients: each thread reuses pooled connections instead
+    # of paying a TCP handshake per request (wire.ConnectionPool)
+    pool = wire.ConnectionPool(timeout_s=30.0,
+                               max_idle_per_key=max(1, concurrency))
 
     def client(idx: int):
         k = idx
@@ -199,7 +213,7 @@ def run_router_load(router_url: str, images_pool, seconds: float,
             k += 1
             t0 = time.perf_counter()
             try:
-                status, rheaders, _data = _http(
+                status, rheaders, _data = pool.request(
                     host, port, "POST", "/augment", body, headers)
             except OSError:
                 with lat_lock:
@@ -233,8 +247,11 @@ def run_router_load(router_url: str, images_pool, seconds: float,
         t.join(timeout=seconds + 60.0)
     wall = time.perf_counter() - t_start
     lat_ms = np.asarray(lats) * 1e3 if lats else np.asarray([0.0])
+    conn_stats = pool.stats()
+    pool.close_all()
     row = {
         "requests_ok": outcomes["ok"],
+        "client_connections": conn_stats,
         "requests_retried": outcomes["retried"],
         "requests_failed": outcomes["failed"],
         "rps": round(outcomes["ok"] / wall, 1) if wall > 0 else 0.0,

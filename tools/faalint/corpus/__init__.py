@@ -35,6 +35,9 @@ CASES = {
         "fast_autoaugment_tpu/train/trainer.py", {"D1"}, "dispatch"),
     "jit_in_loop": (
         "fast_autoaugment_tpu/train/trainer.py", {"D2"}, "dispatch"),
+    # the per-request copy tax the zero-copy data plane removed
+    "npz_per_request": (
+        "fast_autoaugment_tpu/serve/serve_cli.py", {"D4"}, "dispatch"),
     # the byte-identical-artifact contract
     "wallclock_pid_payload": (
         "fast_autoaugment_tpu/core/checkpoint.py", {"T1", "T3"},

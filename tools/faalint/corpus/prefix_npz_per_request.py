@@ -1,0 +1,19 @@
+"""Pre-fix shape of serve_cli's npz ingestion (PR 7–15): every request
+paid a full npz decode copy in (zlib + tensor materialization) and an
+npz encode copy out on the serving hot path — the per-request host
+overhead the zero-copy wire format removed (serve/wire.py)."""
+import io
+
+import numpy as np
+
+
+class Handler:
+    def _do_augment(self, server):
+        body = self.read_body()
+        payload = np.load(io.BytesIO(body), allow_pickle=False)
+        images = np.array(payload["images"])
+        pending = server.submit(images)
+        out = server.result(pending)
+        buf = io.BytesIO()
+        np.savez(buf, images=out.astype(np.uint8))
+        self.send(200, buf.getvalue())

@@ -23,6 +23,15 @@ D3  **mixed mesh-commitment into a jitted entry point** (the measured
     ``jax.device_put``/``place_*``-committed, dispatched alongside a
     committed sibling argument, knocks every call off the C++
     fast path.  Commit the carried state to the mesh before the loop.
+
+D4  **per-request copy on a serving hot path** (serve/ scope only):
+    ``np.load``/``np.savez``/``.tobytes()``/``np.array`` (which copies
+    unless ``copy=False``) inside a request-handling function — a
+    ``do_*``/``_do_*`` method or anything on a ``*Handler*`` class.
+    Each is a full-tensor copy (or zlib codec) paid per request; the
+    zero-copy wire format (serve/wire.py: ``np.frombuffer`` views in,
+    pooled-arena encode out) exists to remove exactly these.  The
+    retained npz fallback lane carries ``# robust: allow``.
 """
 
 from __future__ import annotations
@@ -262,5 +271,65 @@ class MixedCommitDispatch(Rule):
         return out
 
 
+#: numpy calls that are a per-request full-copy (or codec) by nature
+_D4_NP_CALLS = {"load", "savez", "savez_compressed"}
+
+
+class PerRequestCopy(Rule):
+    id = "D4"
+    severity = "warning"
+    pass_name = "dispatch"
+    scope_key = "serve"
+
+    @staticmethod
+    def _is_request_handler(ctx: FileContext, fn) -> bool:
+        """Request-handling unit: a ``do_*``/``_do_*`` function, or any
+        method of a ``*Handler*`` class (the http.server idiom — helper
+        methods like ``_parse_images`` are the same hot path)."""
+        name = getattr(fn, "name", "")
+        if name.startswith(("do_", "_do_")):
+            return True
+        cls = ctx.enclosing(fn, (ast.ClassDef,))
+        return cls is not None and "Handler" in cls.name
+
+    @staticmethod
+    def _copy_pattern(call: ast.Call) -> str | None:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "tobytes":
+            return ".tobytes()"
+        if isinstance(f.value, ast.Name) and f.value.id == "np":
+            if f.attr in _D4_NP_CALLS:
+                return f"np.{f.attr}"
+            if f.attr == "array":
+                for kw in call.keywords:
+                    if kw.arg == "copy" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is False:
+                        return None  # an explicit view, not a copy
+                return "np.array"
+        return None
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for call in ctx.of(ast.Call):
+            fn = ctx.enclosing_function(call)
+            if fn is None or not self._is_request_handler(ctx, fn):
+                continue
+            pat = self._copy_pattern(call)
+            if pat is None:
+                continue
+            out.append(self.finding(
+                ctx, call.lineno,
+                f"{pat} inside request handler '{fn.name}' — a full "
+                "per-request tensor copy (or codec) on the serving hot "
+                "path; use the zero-copy wire format (serve/wire.py: "
+                "np.frombuffer views in, pooled-arena encode out) or "
+                "mark the legacy fallback lane `robust: allow`"))
+        return out
+
+
 def RULES() -> list[Rule]:
-    return [HostSyncInDispatchLoop(), JitInLoop(), MixedCommitDispatch()]
+    return [HostSyncInDispatchLoop(), JitInLoop(), MixedCommitDispatch(),
+            PerRequestCopy()]
