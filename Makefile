@@ -1,7 +1,7 @@
 # test-t1 uses `set -o pipefail`/PIPESTATUS, which POSIX sh lacks
 SHELL := /bin/bash
 
-.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-serve-hotpath bench-compile bench-pipeline bench-fleet-search bench-control trace status clean reproduce chaos
+.PHONY: test test-t1 lint lint-robust lint-selfcheck native bench bench-aug bench-dispatch bench-serve bench-overload bench-router bench-serve-hotpath bench-compile bench-pipeline bench-fleet-search bench-control trace status clean reproduce chaos gameday gameday-smoke
 
 # telemetry journal dir for the trace/status targets (override:
 #   make trace TELEMETRY=/shared/run TRACE_OUT=overlap.json)
@@ -42,6 +42,16 @@ test-t1: lint
 # telemetry-stamped CHAOS line with the reclaim/epoch evidence
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fsfault.py::test_chaos_composed_fault_smoke -q -s -m slow -p no:cacheprovider
+
+# trace-driven game days (docs/GAMEDAYS.md): the full deterministic
+# scenario suite over a real serving plane, verdicts journaled and the
+# suite JSON (with provenance stamps) written next to the docs table.
+# `gameday-smoke` runs the same topologies/predicates under scaled load.
+gameday:
+	JAX_PLATFORMS=cpu python -m fast_autoaugment_tpu.launch.gameday_cli --suite --out docs/gameday.json
+
+gameday-smoke:
+	JAX_PLATFORMS=cpu python -m fast_autoaugment_tpu.launch.gameday_cli --suite --smoke
 
 # real-data fire-drill (VERDICT r3, next-step 8): fetch CIFAR-10 with
 # md5 verification, train WRN-40-2 + fa_reduced_cifar10 at the headline

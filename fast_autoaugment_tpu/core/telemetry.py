@@ -130,6 +130,11 @@ EVENT_TYPES = frozenset({
     "promote",        # the delta gate promoted the candidate fleet-wide
     "rollback",       # the delta gate rolled the canary subset back
     "fsfault",        # the FAA_FSFAULT seam injected a shared-FS fault
+    # trace-driven game days (gameday/, docs/GAMEDAYS.md): the scenario
+    # runner's lifecycle marks and the verdict engine's rows, each
+    # carrying its evidence inline like the decision events above
+    "scenario",       # game-day lifecycle: start/progress/phase/end
+    "verdict",        # one verdict predicate's pass/fail + evidence
 })
 
 

@@ -1,0 +1,22 @@
+"""Trace-driven game days: deterministic scenario drills with
+journaled verdicts (docs/GAMEDAYS.md).
+
+- ``scenario``: composable frozen specs + the named-scenario registry;
+- ``workload``: the seeded open-loop schedule and its replay driver;
+- ``runner``: spec -> live plane -> workload -> verdict record;
+- ``verdict``: the journal-driven predicate catalog.
+
+``make gameday`` / ``make gameday-smoke`` front it via
+``launch/gameday_cli.py``.
+"""
+
+from .scenario import (Kill, Plane, SCENARIOS, Scenario, Traffic,
+                       scaled, suite_names)
+from .verdict import PREDICATES, evaluate, render_table
+from .workload import Offered, build_schedule, schedule_digest
+
+__all__ = [
+    "Kill", "Plane", "SCENARIOS", "Scenario", "Traffic", "scaled",
+    "suite_names", "PREDICATES", "evaluate", "render_table",
+    "Offered", "build_schedule", "schedule_digest",
+]

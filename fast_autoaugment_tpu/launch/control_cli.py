@@ -247,7 +247,7 @@ def main(argv=None) -> int:
                            baseline_n=args.baseline_samples,
                            cusum_k=args.cusum_k, cusum_h=args.cusum_h)
     canary_ctl = CanaryController(
-        lambda: discover_replicas(args.port_dir),
+        lambda: discover_replicas(args.port_dir) or [],
         router_url=args.router_url, timeout_s=args.reload_timeout)
     gate = PromotionGate(gate_polls=args.gate_polls,
                          quality_margin=args.quality_margin,

@@ -142,7 +142,9 @@ class ReplicaScraper:
         """
         now = mono()
         per: dict[str, dict] = {}
-        for rec in discover_replicas(self.port_dir):
+        # None = transiently unobservable census (fsfault seam): an
+        # empty scrape round; hysteresis absorbs the blip
+        for rec in discover_replicas(self.port_dir) or []:
             tag = rec["tag"]
             text = self._scrape_one(rec["host"], rec["port"])
             if text is None:

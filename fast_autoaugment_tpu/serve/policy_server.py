@@ -847,9 +847,15 @@ class PolicyServer:
                  dispatch_timeout_s: float = 0.0,
                  tenant_capacity: int = 0,
                  traffic_stats: bool = False,
-                 double_buffer: bool = False):
+                 double_buffer: bool = False,
+                 dispatch_floor_ms: float = 0.0):
         self.applier = applier
         self.max_batch = int(max_batch or applier.max_batch)
+        # deliberate per-dispatch service-time floor: caps throughput
+        # at max_batch / floor images/s so game-day drills can emulate
+        # a heavy model and reach REAL overload on a 1-core CI host
+        # deterministically.  0.0 (default) = off, bit-for-bit.
+        self.dispatch_floor_s = max(0.0, float(dispatch_floor_ms)) / 1e3
         if self.max_batch > applier.max_batch:
             raise ValueError(
                 f"max_batch {self.max_batch} exceeds the largest AOT "
@@ -1448,6 +1454,8 @@ class PolicyServer:
             if fault is not None and fault[0] == "slow":
                 base = self._wall_ema if self._wall_ema else 1.0
                 time.sleep(min(fault[1] * base, 300.0))
+            if self.dispatch_floor_s > 0.0:
+                time.sleep(self.dispatch_floor_s)
             fn = getattr(applier, "apply_async", None)
             if fn is not None:
                 handle = fn(images, keys, stages=stages)

@@ -52,13 +52,14 @@ docs/SERVING.md documents the plane end to end.
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import http.client
 import json
 import os
 import threading
 
-from fast_autoaugment_tpu.core import telemetry
+from fast_autoaugment_tpu.core import fsfault, telemetry
 from fast_autoaugment_tpu.core.telemetry import mono, wall
 from fast_autoaugment_tpu.serve import wire
 from fast_autoaugment_tpu.utils.logging import get_logger
@@ -104,25 +105,31 @@ def parse_static_replicas(spec: str) -> list[dict]:
     return out
 
 
-def discover_replicas(port_dir: str) -> list[dict]:
+def discover_replicas(port_dir: str) -> list[dict] | None:
     """Read every ``<tag>.json`` replica record under `port_dir`
     (written by ``serve_cli --port-dir``).  Unreadable / torn records
     are skipped — the writer is atomic (os.replace), so a skip means a
-    writer mid-crash, and the next scan settles it."""
+    writer mid-crash, and the next scan settles it.
+
+    Reads go through the ``FAA_FSFAULT`` seam (``core/fsfault.py``):
+    the port dir is a SHARED directory, and on a real remote mount
+    listings lag and reads fail transiently.  A failed LISTING returns
+    ``None`` — "could not observe the census", which is different from
+    "the census is empty": the caller must keep its last-known replica
+    table rather than declaring the whole fleet gone (the stale-fs
+    game day drills exactly this confusion)."""
     records: list[dict] = []
     try:
-        names = sorted(os.listdir(port_dir))
-    except OSError:
-        return records
+        names = fsfault.listdir(port_dir)
+    except OSError as e:
+        if e.errno in (errno.EIO, errno.ESTALE):
+            return None  # transient: census unobservable, not empty
+        return records  # missing/unreadable dir: genuinely no records
     for name in names:
         if not name.endswith(".json") or name.startswith("."):
             continue
         path = os.path.join(port_dir, name)
-        try:
-            with open(path) as fh:
-                rec = json.load(fh)
-        except (OSError, ValueError):
-            continue
+        rec = fsfault.read_json(path)
         if not isinstance(rec, dict):
             continue
         try:
@@ -274,7 +281,17 @@ class Router:
         one is ejected by the poll instead)."""
         if not self.port_dir:
             return
-        recs = {r["tag"]: r for r in discover_replicas(self.port_dir)}
+        found = discover_replicas(self.port_dir)
+        if found is None:
+            # the LISTING failed transiently (injected or real EIO /
+            # ESTALE past the seam's retries): keep the last-known
+            # census instead of declaring the whole fleet departed —
+            # ejection of actually-dead replicas is the health poll's
+            # job, not the flaky listing's
+            logger.warning("router: port-dir listing failed "
+                           "transiently; keeping last census")
+            return
+        recs = {r["tag"]: r for r in found}
         with self._lock:
             for tag, rec in recs.items():
                 cur = self._replicas.get(tag)

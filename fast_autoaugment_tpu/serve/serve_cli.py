@@ -627,11 +627,22 @@ def make_handler(server, applier, state: ServeState | None = None,
                 return
             seg = None
             pending = None
+            images = None
             try:
                 try:
                     name, dtype, shape, keys = wire.decode_shm_request(body)
                     t0 = mono()
                     seg = shared_memory.SharedMemory(name=name)
+                    # attaching registers the segment with OUR resource
+                    # tracker (bpo-39959), which would unlink the
+                    # CLIENT's segment at replica exit — the client
+                    # owns the lifecycle, so unregister immediately
+                    try:
+                        from multiprocessing import resource_tracker
+                        resource_tracker.unregister(
+                            seg._name, "shared_memory")
+                    except (ImportError, AttributeError, KeyError):
+                        pass
                     images = np.ndarray(shape, dtype, buffer=seg.buf)
                     server.observe_stage("decode", mono() - t0)
                     deadline_ms = self._deadline_ms()
@@ -663,6 +674,12 @@ def make_handler(server, applier, state: ServeState | None = None,
                                       "dtype": "uint8",
                                       "shape": list(shape)})
             finally:
+                # drop OUR view first: on the error-response paths
+                # above (shed/cold-tenant/bad-shape) the local still
+                # pins the mapping, and a BufferError'd close() would
+                # leak the map until a GC pass — under a flash crowd
+                # that is a real /dev/shm-backed memory leak
+                images = None  # the rebind releases the view
                 if pending is not None:
                     # drop the pending's zero-copy view into the
                     # segment so close() below can release the mapping
@@ -948,6 +965,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "failure even when results arrive (a straggler "
                         "budget; pairs with --watchdog for true hangs).  "
                         "0 = off")
+    p.add_argument("--dispatch-floor-ms", type=float, default=0.0,
+                   help="deliberate per-dispatch service-time floor in "
+                        "ms (game-day drills: emulates a heavy model so "
+                        "a 1-core host reaches real overload "
+                        "deterministically).  0 = off")
     p.add_argument("--breaker-exit", action="store_true",
                    help="exit 77 ('restart me') when the breaker opens — "
                         "under fleet supervision (--no-rank-args) the "
@@ -1075,7 +1097,8 @@ def main(argv=None):
         dispatch_timeout_s=args.dispatch_timeout,
         tenant_capacity=args.tenant_capacity,
         traffic_stats=args.traffic_stats,
-        double_buffer=args.double_buffer).start()
+        double_buffer=args.double_buffer,
+        dispatch_floor_ms=args.dispatch_floor_ms).start()
     state = ServeState(server, args.policy, build_applier,
                        policy_dir=args.policy_dir)
     cc = compile_cache_stats()

@@ -322,6 +322,47 @@ class ShmRegion:
 # --------------------------------------------------- keep-alive pool
 
 
+class _TrackedConnection(http.client.HTTPConnection):
+    """``HTTPConnection`` that counts the bytes actually written to the
+    socket during the current request attempt (``bytes_sent``).
+
+    The count is byte-exact even through a mid-write failure: sends go
+    through a ``socket.send`` loop (whose partial-write count survives
+    the raise) instead of ``sendall`` (which loses it).  The pool's
+    stale-keep-alive retry consults the counter — replaying a request
+    is safe ONLY while zero bytes of it reached the wire, because the
+    server cannot have seen any of it; after the first byte a replay
+    risks a double-send of a request the server may already be
+    processing.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.bytes_sent = 0
+
+    def send(self, data):
+        if self.sock is None:
+            if self.auto_open:
+                self.connect()
+            else:
+                raise http.client.NotConnected()
+        try:
+            view = memoryview(data)
+        except TypeError:
+            # file-like / str bodies are not byte-exact trackable:
+            # mark the attempt dirty up front (a retry after this
+            # could double-send), then delegate.  The pool itself
+            # only ever passes bytes.
+            self.bytes_sent += 1
+            return super().send(data)
+        with view.cast("B") as flat:
+            off, total = 0, len(flat)
+            while off < total:
+                n = self.sock.send(flat[off:])
+                off += n
+                self.bytes_sent += n
+
+
 class ConnectionPool:
     """Keep-alive ``http.client`` connections, pooled per
     ``(host, port)``.
@@ -329,11 +370,15 @@ class ConnectionPool:
     ``request()`` borrows a pooled connection (opening one only when
     the pool is dry), issues the request, reads the response fully and
     returns the connection for reuse.  A **reused** connection that
-    fails mid-request is retried exactly once on a fresh socket — the
-    standard stale-keep-alive rule (the server may have closed an idle
-    connection between our requests); a fresh connection's failure
-    propagates (a real upstream error).  Bounded idle connections per
-    key; thread-safe.
+    fails BEFORE ANY BYTE of the request reached the wire is retried
+    exactly once on a fresh socket — the stale-keep-alive rule (the
+    server may have closed an idle connection between our requests),
+    narrowed so the retry can never double-send: once even one byte
+    was written the server may already be processing the request, so
+    the failure propagates instead (partially-written bodies and
+    response-stage failures are the caller's to judge).  A fresh
+    connection's failure always propagates (a real upstream error).
+    Bounded idle connections per key; thread-safe.
     """
 
     def __init__(self, timeout_s: float = 5.0, max_idle_per_key: int = 4):
@@ -352,8 +397,8 @@ class ConnectionPool:
                 self._reuses += 1
                 return pool.pop(), True
             self._opens += 1
-        conn = http.client.HTTPConnection(host, int(port),
-                                          timeout=self.timeout_s)
+        conn = _TrackedConnection(host, int(port),
+                                  timeout=self.timeout_s)
         conn.connect()
         # persistent connections leave Linux's initial TCP quickack
         # mode, so Nagle + delayed-ACK then stalls every small
@@ -380,6 +425,7 @@ class ConnectionPool:
         last_exc: Exception | None = None
         for attempt in (0, 1):
             conn, reused = self._acquire(host, port)
+            conn.bytes_sent = 0
             try:
                 conn.request(method, path, body=body,
                              headers=dict(headers or {}))
@@ -396,7 +442,13 @@ class ConnectionPool:
                 last_exc = e
                 if not reused:
                     raise  # fresh socket: a real upstream failure
-                # stale keep-alive: retry once on a fresh connection
+                if conn.bytes_sent > 0:
+                    # part (or all) of the request reached the wire:
+                    # the server may be processing it, so a replay
+                    # could double-send — surface the failure instead
+                    raise
+                # stale keep-alive detected before any byte left the
+                # host: safe to replay once on a fresh connection
         raise last_exc  # pragma: no cover — loop always returns/raises
 
     def stats(self) -> dict:

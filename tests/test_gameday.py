@@ -1,0 +1,438 @@
+"""Trace-driven game days (fast_autoaugment_tpu/gameday/).
+
+Fast half, host-only (no plane, no jax): the offered schedule is a
+pure function of ``(scenario, seed)`` — byte-identical across builds,
+digest-stamped; request bodies are deterministic; ``scaled`` shrinks
+load while scaling the dispatch floor inversely so overload scenarios
+still overload; and EVERY verdict predicate is exercised against
+synthetic evidence dicts, including the expected-fail semantics that
+keep the broken-config demonstration honest.
+
+Slow half: one smoke-scaled suite over a real plane — a healthy
+scenario must PASS and the deliberately broken no-shedding flash
+crowd must FAIL (and the suite must be GREEN precisely because it
+failed on cue).
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from fast_autoaugment_tpu.core import telemetry as T
+from fast_autoaugment_tpu.gameday import (
+    SCENARIOS,
+    Traffic,
+    build_schedule,
+    schedule_digest,
+    scaled,
+    suite_names,
+)
+from fast_autoaugment_tpu.gameday.verdict import (
+    PREDICATES,
+    evaluate,
+    render_table,
+)
+from fast_autoaugment_tpu.gameday.workload import (
+    SHED_STATUSES,
+    request_body,
+)
+from fast_autoaugment_tpu.serve import wire
+
+
+@pytest.fixture(autouse=True)
+def _quiet_telemetry():
+    yield
+    T._disable_for_tests()
+
+
+# ------------------------------------------------- schedule identity
+
+
+def test_schedule_is_deterministic_for_every_scenario():
+    for name, scn in SCENARIOS.items():
+        a = build_schedule(scn.traffic, scn.seed)
+        b = build_schedule(scn.traffic, scn.seed)
+        assert a == b, name
+        assert schedule_digest(a) == schedule_digest(b), name
+        assert len(schedule_digest(a)) == 16
+        assert len(a) > 0, name
+
+
+def test_schedule_digest_tracks_seed_and_traffic():
+    scn = SCENARIOS["flash-crowd-10x"]
+    base = schedule_digest(build_schedule(scn.traffic, scn.seed))
+    other_seed = schedule_digest(
+        build_schedule(scn.traffic, scn.seed + 1))
+    other_shape = schedule_digest(build_schedule(
+        dataclasses.replace(scn.traffic, base_rps=scn.traffic.base_rps
+                            + 1.0), scn.seed))
+    assert base != other_seed
+    assert base != other_shape
+
+
+def test_request_bodies_are_deterministic_and_decodable():
+    scn = SCENARIOS["flash-crowd-10x"]
+    sched = build_schedule(scn.traffic, scn.seed)
+    by_lane = {}
+    for o in sched:
+        by_lane.setdefault(o.lane, o)
+    assert set(by_lane) == {"raw", "npz", "shm"}
+    for lane, o in by_lane.items():
+        b1, h1, imgs1 = request_body(o, image=8)
+        b2, h2, imgs2 = request_body(o, image=8)
+        assert b1 == b2
+        if lane == "shm":
+            # shm bodies are built later from a process-unique region;
+            # the deterministic part is the tensor + the PRNG keys
+            np.testing.assert_array_equal(imgs1, imgs2)
+            keys = h1["_keys"]
+            assert keys.shape == (o.batch, 2)
+            assert (keys[:, 0] == 0).all()  # PRNGKey(seed) layout
+        else:
+            assert imgs1 is None and h1 == h2
+    imgs, keys = wire.decode_raw(request_body(by_lane["raw"], 8)[0])
+    assert imgs.shape == (by_lane["raw"].batch, 8, 8, 3)
+    assert keys.shape == (by_lane["raw"].batch, 2)
+
+
+def test_rate_curves():
+    flash = Traffic(kind="flash", duration_s=10.0, base_rps=5.0,
+                    peak_rps=50.0, flash_at_frac=0.5, ramp_s=2.0)
+    assert flash.rate_at(-1.0) == 0.0 and flash.rate_at(10.0) == 0.0
+    assert flash.rate_at(1.0) == 5.0
+    assert flash.rate_at(6.0) == pytest.approx(27.5)  # mid-ramp
+    assert flash.rate_at(9.0) == 50.0
+    assert flash.peak_rate == 50.0
+    di = Traffic(kind="diurnal", duration_s=20.0, base_rps=4.0,
+                 peak_rps=12.0, period_s=10.0)
+    assert di.rate_at(0.0) == pytest.approx(4.0)
+    assert di.rate_at(5.0) == pytest.approx(12.0)
+    assert Traffic(kind="constant", base_rps=7.0).rate_at(1.0) == 7.0
+    with pytest.raises(ValueError, match="unknown traffic kind"):
+        Traffic(kind="lunar").rate_at(1.0)
+
+
+# ------------------------------------------------- registry + scaling
+
+
+def test_registry_has_teeth_and_resolvable_predicates():
+    names = suite_names()
+    assert len(names) >= 6 and names == list(SCENARIOS)
+    expects = [SCENARIOS[n].expect for n in names]
+    assert expects.count("pass") >= 5
+    assert expects.count("fail") >= 1  # the standing teeth-proof
+    for name in names:
+        for pred, params in SCENARIOS[name].predicates:
+            assert pred in PREDICATES, f"{name}: {pred}"
+            assert isinstance(params, dict)
+
+
+def test_scaled_shrinks_load_and_scales_dispatch_floor():
+    scn = SCENARIOS["flash-crowd-10x"]
+    sm = scaled(scn, 0.4)
+    assert sm.traffic.duration_s == pytest.approx(
+        scn.traffic.duration_s * 0.4)
+    assert sm.traffic.peak_rps == pytest.approx(
+        scn.traffic.peak_rps * 0.4)
+    # capacity shrinks WITH the offered load (floor grows by 1/factor)
+    # so the overload the scenario drills still materializes in smoke
+    assert sm.plane.dispatch_floor_ms == pytest.approx(
+        scn.plane.dispatch_floor_ms / 0.4)
+    assert sm.predicates == scn.predicates
+    no_floor = scaled(SCENARIOS["replica-loss-mid-canary"], 0.4)
+    assert no_floor.plane.dispatch_floor_ms == 0.0
+
+
+# ------------------------------------------------- verdict predicates
+
+
+def _report(**kw) -> dict:
+    base = {"offered": 100, "completed": 100, "ok": 90, "shed": 10,
+            "unexpected_status": 0, "transport_errors": 0,
+            "cancelled": 0, "ok_by_tenant": {"0": 90},
+            "shed_by_status": {"503": 10}, "p99_ms_ok": 50.0,
+            "shm_created": 5, "shm_leftover": [],
+            "errors_sample": []}
+    base.update(kw)
+    return base
+
+
+def _ev(journal=(), **kw) -> dict:
+    ev = {"report": _report(), "journal": list(journal),
+          "router_stats": None, "killed": None, "tenants": 1}
+    ev.update(kw)
+    return ev
+
+
+def _rec(etype, t, **fields):
+    return {"type": etype, "t_wall": t, **fields}
+
+
+def test_goodput_floor():
+    assert PREDICATES["goodput_floor"](_ev(), floor=0.9).ok
+    assert not PREDICATES["goodput_floor"](_ev(), floor=0.95).ok
+    row = PREDICATES["goodput_floor"](
+        {"report": _report(offered=0, ok=0)}, floor=0.1)
+    assert not row.ok  # nothing offered can never satisfy a floor
+
+
+def test_shed_not_hang():
+    assert PREDICATES["shed_not_hang"](_ev()).ok
+    hung = _ev(report=_report(transport_errors=3))
+    assert not PREDICATES["shed_not_hang"](hung).ok
+    assert PREDICATES["shed_not_hang"](hung, max_hung=3).ok
+    weird = _ev(report=_report(unexpected_status=1))
+    assert not PREDICATES["shed_not_hang"](weird).ok
+    slow = _ev(report=_report(p99_ms_ok=900.0))
+    assert not PREDICATES["shed_not_hang"](slow, p99_ms_ok=500.0).ok
+
+
+def test_max_transport_errors():
+    assert PREDICATES["max_transport_errors"](_ev(), max_errors=0).ok
+    bad = _ev(report=_report(transport_errors=2,
+                             errors_sample=["timed out"]))
+    row = PREDICATES["max_transport_errors"](bad, max_errors=1)
+    assert not row.ok and row.observed["errors_sample"] == ["timed out"]
+
+
+def test_affinity_floor():
+    stats = {"affinity": {"hits": 80, "misses": 20, "hit_rate": 0.8}}
+    assert PREDICATES["affinity_floor"](
+        _ev(router_stats=stats), floor=0.75).ok
+    assert not PREDICATES["affinity_floor"](
+        _ev(router_stats=stats), floor=0.9).ok
+    # no router stats at all is a FAIL, not a vacuous pass
+    assert not PREDICATES["affinity_floor"](_ev(), floor=0.1).ok
+
+
+def test_autoscaler_bounds():
+    j = [_rec("scale_up", 1.0, replicas_after=2),
+         _rec("scale_up", 2.0, replicas_after=3),
+         _rec("scale_down", 9.0, replicas_after=2)]
+    row = PREDICATES["autoscaler_bounds"](
+        _ev(journal=j), min_replicas=1, max_replicas=3,
+        require_scale_up=True)
+    assert row.ok and row.observed["scale_ups"] == 2
+    out = j + [_rec("scale_up", 3.0, replicas_after=7)]
+    assert not PREDICATES["autoscaler_bounds"](
+        _ev(journal=out), min_replicas=1, max_replicas=3).ok
+    # a flash scenario that never scaled up fails its requirement
+    assert not PREDICATES["autoscaler_bounds"](
+        _ev(journal=[]), min_replicas=1, max_replicas=3,
+        require_scale_up=True).ok
+
+
+def test_control_decision_requires_causal_order():
+    good = [_rec("drift", 1.0), _rec("canary", 2.0, action="rollout"),
+            _rec("promote", 8.0)]
+    assert PREDICATES["control_decision"](_ev(journal=good)).ok
+    rollback = [_rec("drift", 1.0),
+                _rec("canary", 2.0, action="rollout"),
+                _rec("rollback", 8.0)]
+    row = PREDICATES["control_decision"](_ev(journal=rollback))
+    assert row.ok and row.observed["decision"] == "rollback"
+    shuffled = [_rec("canary", 1.0, action="rollout"),
+                _rec("drift", 2.0), _rec("promote", 8.0)]
+    assert not PREDICATES["control_decision"](
+        _ev(journal=shuffled)).ok
+    no_terminal = good[:2]
+    assert not PREDICATES["control_decision"](
+        _ev(journal=no_terminal)).ok
+    assert PREDICATES["control_decision"](
+        _ev(journal=no_terminal), require_terminal=False).ok
+
+
+def test_rotation_ejected_falls_back_to_killed_tag():
+    j = [_rec("rotation", 1.0, action="eject", replica="replica2")]
+    assert PREDICATES["rotation_ejected"](
+        _ev(journal=j, killed="replica2")).ok
+    assert not PREDICATES["rotation_ejected"](
+        _ev(journal=j, killed="replica0")).ok
+    assert PREDICATES["rotation_ejected"](
+        _ev(journal=j), tag="replica2").ok
+    assert not PREDICATES["rotation_ejected"](_ev()).ok
+
+
+def test_tenant_churn_and_cohort_service():
+    j = [_rec("tenant", t, action="admit") for t in (1.0, 2.0, 3.0)] \
+        + [_rec("tenant", 4.0, action="evict")]
+    assert PREDICATES["tenant_churn"](
+        _ev(journal=j), min_admits=3, min_evicts=1).ok
+    assert not PREDICATES["tenant_churn"](
+        _ev(journal=j), min_admits=4, min_evicts=1).ok
+    served = _ev(report=_report(ok_by_tenant={"0": 5, "1": 2, "2": 1,
+                                              "3": 9}), tenants=4)
+    assert PREDICATES["all_cohorts_served"](served).ok
+    starved = _ev(report=_report(ok_by_tenant={"0": 5, "1": 2}),
+                  tenants=4)
+    row = PREDICATES["all_cohorts_served"](starved)
+    assert not row.ok and row.observed["starved"] == [2, 3]
+
+
+def test_fsfault_observed_and_no_shm_leak():
+    j = [_rec("fsfault", 1.0, kind="lag"), _rec("fsfault", 2.0,
+                                                kind="eio")]
+    assert PREDICATES["fsfault_observed"](_ev(journal=j)).ok
+    # surviving faults that never fired proves nothing
+    assert not PREDICATES["fsfault_observed"](_ev()).ok
+    assert PREDICATES["no_shm_leak"](_ev()).ok
+    leak = _ev(report=_report(shm_leftover=["psm_dead"]))
+    assert not PREDICATES["no_shm_leak"](leak).ok
+
+
+# ------------------------------------------------- evaluate + table
+
+
+def test_evaluate_expected_fail_inverts_suite_greenness():
+    scn = SCENARIOS["flash-crowd-10x-noshed"]
+    assert scn.expect == "fail"
+    hung = _ev(report=_report(transport_errors=40, ok=30))
+    rec = evaluate(scn, hung, schedule_digest="cafe")
+    assert rec["pass"] is False
+    assert rec["ok_as_expected"] is True  # failed ON CUE => green
+    healthy = evaluate(scn, _ev(), schedule_digest="cafe")
+    assert healthy["pass"] is True
+    assert healthy["ok_as_expected"] is False  # teeth-proof missed
+
+
+def test_evaluate_contains_unknown_and_crashing_predicates():
+    scn = dataclasses.replace(
+        SCENARIOS["stale-fs-under-load"],
+        predicates=(("no_such_predicate", {}),
+                    ("goodput_floor", {"floor": 0.1})))
+    rec = evaluate(scn, _ev())
+    rows = {r["predicate"]: r for r in rec["predicates"]}
+    assert rows["no_such_predicate"]["ok"] is False
+    assert rows["goodput_floor"]["ok"] is True
+    assert rec["pass"] is False
+    # a predicate crash (evidence missing a key) is a failing row,
+    # not an exception out of the engine
+    crash = evaluate(dataclasses.replace(
+        scn, predicates=(("goodput_floor", {"floor": 0.1}),)),
+        {"journal": []})
+    assert crash["pass"] is False
+    assert crash["predicates"][0]["detail"] == "predicate crashed"
+
+
+def test_render_table_marks_expectations():
+    scn = SCENARIOS["flash-crowd-10x-noshed"]
+    ok_rec = evaluate(SCENARIOS["stale-fs-under-load"], _ev(journal=[
+        _rec("fsfault", 1.0, kind="lag")]))
+    fail_rec = evaluate(
+        scn, _ev(report=_report(transport_errors=40, ok=30)))
+    table = render_table([ok_rec, fail_rec])
+    assert "FAIL (expected-fail)" in table
+    assert "suite:" in table
+    missed = evaluate(scn, _ev())  # broken config passed => RED
+    assert "RED" in render_table([ok_rec, missed])
+    assert "(!! expected FAIL)" in render_table([missed])
+
+
+def test_scenario_and_verdict_are_journaled_event_types(tmp_path):
+    d = str(tmp_path / "tel")
+    T.enable_telemetry(d, tb_bridge=False)
+    T.emit("scenario", "gameday", scenario="x", action="start", seed=1)
+    T.emit("verdict", "gameday", scenario="x",
+           predicate="goodput_floor", ok=True)
+    T.journal_flush()
+    records = []
+    for path in sorted(glob.glob(os.path.join(d, "journal-*.jsonl"))):
+        with open(path) as fh:
+            records.extend(json.loads(ln) for ln in fh if ln.strip())
+    types = {r["type"] for r in records}
+    assert {"scenario", "verdict"} <= types
+
+
+def test_faa_status_gameday_section(tmp_path):
+    d = str(tmp_path / "tel")
+    T.enable_telemetry(d, tb_bridge=False)
+    T.emit("scenario", "flash-crowd-10x", action="start", seed=20,
+           schedule_digest="ab12", requests=100, traffic="flash",
+           expect="pass")
+    T.emit("scenario", "flash-crowd-10x", action="phase",
+           phase="traffic")
+    T.emit("scenario", "flash-crowd-10x", action="progress",
+           offered=40, completed=30, ok=24)
+    T.emit("scenario", "flash-crowd-10x", action="kill",
+           replica="replica1", victim_pid=4242)
+    T.emit("verdict", "stale-fs-under-load",
+           predicate="goodput_floor", ok=True,
+           observed={"goodput": 0.97}, bound={"floor": 0.8})
+    T.emit("scenario", "stale-fs-under-load", action="end",
+           passed=True, expect="pass", ok_as_expected=True,
+           schedule_digest="cd34", elapsed_s=31.0)
+    T.journal_flush()
+    from faa_status import fleet_status, gameday_status, render_table
+
+    status = fleet_status(d)
+    gd = status["gameday"]
+    act = gd["active"]
+    assert act["scenario"] == "flash-crowd-10x"
+    assert act["phase"] == "traffic"
+    assert act["offered"] == 40 and act["ok"] == 24
+    assert act["served_frac"] == pytest.approx(0.6)
+    assert gd["finished"][0]["scenario"] == "stale-fs-under-load"
+    assert gd["finished"][0]["ok_as_expected"] is True
+    assert gd["kills"][0]["replica"] == "replica1"
+    assert gd["kills"][0]["victim_pid"] == 4242
+    assert gd["verdict_total"] == 1
+    table = render_table(status)
+    assert "game day:" in table
+    assert "ACTIVE flash-crowd-10x" in table
+    assert "offered=40 served=24" in table
+    assert "stale-fs-under-load :: goodput_floor: ok" in table
+    # an empty journal has no game-day section at all
+    assert gameday_status([]) is None
+
+
+def test_shed_statuses_are_the_structured_rejections():
+    assert SHED_STATUSES == {400, 408, 413, 429, 503}
+    assert 500 not in SHED_STATUSES  # a 500 is a plane bug, not a shed
+    assert 502 not in SHED_STATUSES  # transport-only failure
+
+
+# ------------------------------------------------- live plane (slow)
+
+
+@pytest.mark.slow
+def test_smoke_suite_passes_healthy_and_fails_broken(tmp_path):
+    """One smoke-scaled mini-suite over a REAL plane: the healthy
+    stale-fs scenario must pass, the no-shedding flash crowd must fail
+    — and the suite is GREEN precisely because it failed on cue."""
+    from fast_autoaugment_tpu.gameday.runner import run_suite
+
+    root = str(tmp_path / "gd")
+    result = run_suite(["stale-fs-under-load", "flash-crowd-10x-noshed"],
+                       smoke=True, keep=True, root=root)
+    by_name = {r["scenario"]: r for r in result["records"]}
+    healthy = by_name["stale-fs-under-load"]
+    broken = by_name["flash-crowd-10x-noshed"]
+    assert healthy.get("error") is None
+    assert healthy["pass"] is True, healthy
+    assert healthy["ok_as_expected"] is True
+    assert healthy["schedule_digest"]
+    assert broken["pass"] is False, broken
+    assert broken["ok_as_expected"] is True  # failed ON CUE
+    assert result["suite_green"] is True
+    assert "GREEN" in result["table"]
+    # the run journaled its own scenario lifecycle + verdicts
+    journal = []
+    pattern = os.path.join(root, "stale-fs-under-load", "telemetry",
+                           "**", "journal-*.jsonl")
+    for path in glob.glob(pattern, recursive=True):
+        with open(path) as fh:
+            journal.extend(json.loads(ln) for ln in fh if ln.strip())
+    types = {r["type"] for r in journal}
+    assert {"scenario", "verdict", "fsfault"} <= types
+    # nothing the workload created is left in /dev/shm
+    assert healthy["report"]["shm_leftover"] == []
+    assert broken["report"]["shm_leftover"] == []

@@ -398,6 +398,49 @@ def test_digestless_requests_round_robin(stubs):
     assert counts == [2, 2, 2]
 
 
+def test_batch_forwarder_falls_back_per_part_exactly_once(stubs):
+    """A replica dying between lane assignment and the framed flush:
+    every coalesced entry falls back through the singleton forward
+    path to a survivor EXACTLY once — no lost parts, no double-sends,
+    and each fallback is visible in the fallbacks counter."""
+    from fast_autoaugment_tpu.serve.router import BatchForwarder
+
+    r = _router(static_replicas=_static(stubs), readmit_after=1,
+                failover_attempts=2)
+    _ready(r)
+    fwd = BatchForwarder(r, window_ms=400.0)
+    digest = "feed99"
+    tags = ["r0", "r1", "r2"]
+    victim_tag = rendezvous_order(digest, tags)[0]
+    victim = stubs[tags.index(victim_tag)]
+    results: list = [None] * 3
+
+    def go(i: int) -> None:
+        results[i] = fwd.submit(
+            f"part{i}".encode(),
+            {"Content-Type": "application/octet-stream",
+             "Content-Length": "5"}, digest)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)   # all three entries are parked in the victim lane
+    victim.close()    # replica dies BEFORE the leader ships the frame
+    for t in threads:
+        t.join(timeout=30)
+    assert all(res is not None for res in results)
+    assert [res[0] for res in results] == [200, 200, 200]
+    assert victim_tag not in {res[3] for res in results}
+    # exactly-once: the survivors saw each part once, as singleton
+    # /augment POSTs (never a replayed frame), and nothing twice
+    survivor_reqs = [q for s in stubs if s is not victim
+                     for q in s.requests]
+    assert len(survivor_reqs) == 3
+    assert all(q["path"] == "/augment" for q in survivor_reqs)
+    assert fwd.stats()["fallbacks"] == 3
+    assert fwd.stats()["flushes"] == 0  # the framed flush never landed
+
+
 # ------------------------------------------------- FAA_FAULT verbs
 
 

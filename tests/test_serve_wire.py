@@ -3,7 +3,9 @@ and its HTTP integration (serve_cli raw/frames/shm lanes, keep-alive).
 
 Fast half: pure codec/pool contracts — raw-format roundtrips are
 zero-copy views, the arena recycles buffers, frames pack/unpack, the
-connection pool reuses sockets and survives a stale keep-alive.  Slow
+connection pool reuses sockets, survives a stale keep-alive, and
+refuses to replay once ANY request byte reached the wire (half-written
+or fully-sent — both propagate, a replay could double-send).  Slow
 half: through a live ``make_handler`` server — the raw format serves
 the SAME BYTES as the legacy npz format, the batch endpoint scatters
 per-part responses, the shm lane round-trips without image bytes on
@@ -200,6 +202,144 @@ def test_pool_retries_stale_keepalive_once():
         httpd.server_close()
 
 
+def _counting_server():
+    """Keep-alive server that records every request it fully parsed —
+    the ground truth for double-send assertions."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    served = []
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _serve(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            self.rfile.read(n)
+            served.append((self.command, self.path))
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = do_POST = _serve
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, served
+
+
+class _PartialSendSock:
+    """Socket wrapper that lets the first ``limit`` bytes through and
+    then dies mid-write — a half-written request on the wire."""
+
+    def __init__(self, real, limit):
+        self._real = real
+        self._limit = limit
+
+    def send(self, data):
+        if self._limit <= 0:
+            raise ConnectionResetError("injected mid-write failure")
+        n = self._real.send(memoryview(data)[:self._limit])
+        self._limit -= n
+        return n
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+class _DeadResponseSock:
+    """Socket wrapper that sends fine but hands back an empty response
+    stream — the peer vanished AFTER the request was fully written."""
+
+    def __init__(self, real):
+        self._real = real
+
+    def send(self, data):
+        return self._real.send(data)
+
+    def makefile(self, *a, **k):
+        return io.BytesIO(b"")
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def test_pool_no_retry_after_partial_body_write():
+    # a reused connection that dies with part of the request already on
+    # the wire must NOT be replayed: the server may be processing the
+    # half it saw, and a replay risks a double-send
+    httpd, served = _counting_server()
+    try:
+        port = httpd.server_address[1]
+        pool = wire.ConnectionPool(timeout_s=10.0)
+        assert pool.request("127.0.0.1", port, "GET", "/")[0] == 200
+        conn = pool._idle[("127.0.0.1", port)][0]
+        conn.sock = _PartialSendSock(conn.sock, limit=8)
+        with pytest.raises(ConnectionResetError):
+            pool.request("127.0.0.1", port, "POST", "/augment",
+                         b"x" * 64)
+        # no retry happened: no fresh socket was opened for the failed
+        # attempt, and the server never parsed a second request
+        assert pool.stats()["opens"] == 1
+        assert served == [("GET", "/")]
+        # the pool itself is healthy — the next request opens fresh
+        assert pool.request("127.0.0.1", port, "GET", "/")[0] == 200
+        assert pool.stats()["opens"] == 2
+        assert len(served) == 2
+        pool.close_all()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_pool_no_retry_after_request_fully_sent():
+    # response-stage failures are NOT the stale-keep-alive case either:
+    # the request reached the server, so a replay would double-send it
+    import http.client
+
+    httpd, served = _counting_server()
+    try:
+        port = httpd.server_address[1]
+        pool = wire.ConnectionPool(timeout_s=10.0)
+        assert pool.request("127.0.0.1", port, "POST", "/augment",
+                            b"y" * 32)[0] == 200
+        conn = pool._idle[("127.0.0.1", port)][0]
+        conn.sock = _DeadResponseSock(conn.sock)
+        with pytest.raises(http.client.RemoteDisconnected):
+            pool.request("127.0.0.1", port, "POST", "/augment",
+                         b"y" * 32)
+        assert pool.stats()["opens"] == 1
+        # the server DID see the doomed request exactly once — and no
+        # replay of it ever arrived
+        deadline = threading.Event()
+        deadline.wait(0.2)
+        assert served == [("POST", "/augment"), ("POST", "/augment")]
+        pool.close_all()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------- shm lane lifecycle
+
+
+def test_shm_region_unlinks_on_close():
+    import os
+
+    region = wire.ShmRegion((2, 4, 4, 3), np.float32)
+    path = f"/dev/shm/{region.name}"
+    if not os.path.exists(path):
+        pytest.skip("shm segments not backed by /dev/shm here")
+    region.close()
+    assert not os.path.exists(path)
+    region.close()  # idempotent
+
+
 # --------------------------------------------- HTTP integration (slow)
 
 
@@ -343,3 +483,47 @@ def test_oversized_body_refused_before_read(live_server):
         assert resp.getheader("Connection") == "close"
     finally:
         conn.close()
+
+
+@pytest.mark.slow
+def test_shm_error_path_releases_server_mapping(live_server):
+    """A rejected shm request must not strand the SERVER's mapping of
+    the client's segment — under a flash crowd a pinned mapping per
+    shed request is a real /dev/shm memory leak."""
+    import os
+    import time
+
+    port, _applier = live_server
+    n = 5  # one over the applier's max AOT shape -> submit refuses
+    region = wire.ShmRegion((n, IMG, IMG, 3), np.float32)
+    path = f"/dev/shm/{region.name}"
+    if not os.path.exists(path):
+        pytest.skip("shm segments not backed by /dev/shm here")
+
+    def mapped() -> int:
+        with open("/proc/self/maps") as fh:
+            return sum(1 for ln in fh if region.name in ln)
+
+    pool = wire.ConnectionPool(timeout_s=60.0)
+    try:
+        rng = np.random.default_rng(5)
+        region.write(rng.random((n, IMG, IMG, 3), dtype=np.float32))
+        base = mapped()  # our own client-side mapping(s)
+        keys = np.arange(2 * n, dtype=np.uint32).reshape(n, 2)
+        status, _h, resp = pool.request(
+            "127.0.0.1", port, "POST", "/augment",
+            region.request_body(seeds=keys),
+            {"Content-Type": wire.SHM_CONTENT_TYPE})
+        assert status == 400, resp
+        assert json.loads(resp)["type"] == "bad_request"
+        # the handler's finally must drop its view and close its map;
+        # poll briefly — the client can read the response a beat
+        # before the server thread reaches its finally
+        deadline = time.monotonic() + 5.0
+        while mapped() > base and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert mapped() <= base
+    finally:
+        pool.close_all()
+        region.close()
+    assert not os.path.exists(path)

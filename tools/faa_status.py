@@ -503,6 +503,88 @@ def control_plane_status(journal: list[dict],
     }
 
 
+def gameday_status(journal: list[dict],
+                   verdict_rows: int = 12) -> dict | None:
+    """The game-day section (docs/GAMEDAYS.md): the active scenario
+    (latest ``start`` without a newer ``end``), its current phase and
+    rolling offered-vs-served progress, mid-scenario kills, finished
+    verdicts — all from the journal's typed ``scenario`` / ``verdict``
+    events.  None when the journal shows no game day."""
+    starts: list[dict] = []
+    ends: dict[str, dict] = {}
+    phases: dict[str, str] = {}
+    progress: dict[str, dict] = {}
+    kills: list[dict] = []
+    verdicts: list[dict] = []
+    for rec in journal:
+        etype = rec.get("type")
+        if etype == "scenario":
+            name = str(rec.get("label"))
+            action = rec.get("action")
+            if action == "start":
+                starts.append(rec)
+            elif action == "end":
+                ends[name] = rec
+            elif action == "phase":
+                phases[name] = rec.get("phase")
+            elif action == "progress":
+                progress[name] = rec
+            elif action == "kill":
+                kills.append({"scenario": name,
+                              "replica": rec.get("replica"),
+                              "victim_pid": rec.get("victim_pid"),
+                              "t_wall": rec.get("t_wall")})
+        elif etype == "verdict":
+            verdicts.append({
+                "scenario": str(rec.get("label")),
+                "predicate": rec.get("predicate"),
+                "ok": rec.get("ok"),
+                "observed": rec.get("observed"),
+                "t_wall": rec.get("t_wall")})
+    if not (starts or verdicts):
+        return None
+    starts.sort(key=lambda r: r.get("t_wall") or 0)
+    verdicts.sort(key=lambda r: r.get("t_wall") or 0)
+    active = None
+    for rec in reversed(starts):
+        name = str(rec.get("label"))
+        end = ends.get(name)
+        if end is not None and (end.get("t_wall") or 0) \
+                >= (rec.get("t_wall") or 0):
+            break  # the newest scenario already finished
+        prog = progress.get(name) or {}
+        offered, ok = prog.get("offered"), prog.get("ok")
+        active = {
+            "scenario": name,
+            "seed": rec.get("seed"),
+            "schedule_digest": rec.get("schedule_digest"),
+            "expect": rec.get("expect"),
+            "requests": rec.get("requests"),
+            "phase": phases.get(name) or "bring-up",
+            "offered": offered,
+            "completed": prog.get("completed"),
+            "ok": ok,
+            "served_frac": (round(ok / offered, 4)
+                            if offered and ok is not None else None),
+        }
+        break
+    finished = [
+        {"scenario": name, "passed": end.get("passed"),
+         "expect": end.get("expect"),
+         "ok_as_expected": end.get("ok_as_expected"),
+         "schedule_digest": end.get("schedule_digest"),
+         "elapsed_s": end.get("elapsed_s"), "t_wall": end.get("t_wall")}
+        for name, end in ends.items()]
+    finished.sort(key=lambda r: r.get("t_wall") or 0)
+    return {
+        "active": active,
+        "finished": finished,
+        "kills": kills,
+        "verdicts": verdicts[-max(0, int(verdict_rows)):],
+        "verdict_total": len(verdicts),
+    }
+
+
 def fleet_status(root: str, ttl: float = 60.0,
                  now: float | None = None,
                  port_dir: str | None = None) -> dict:
@@ -565,6 +647,9 @@ def fleet_status(root: str, ttl: float = 60.0,
     control = control_plane_status(journal)
     if control is not None:
         out["control"] = control
+    gameday = gameday_status(journal)
+    if gameday is not None:
+        out["gameday"] = gameday
     return out
 
 
@@ -712,6 +797,37 @@ def render_table(status: dict) -> str:
         tail += (f"\n  decisions: {control.get('promotes', 0)} "
                  f"promote(s), {control.get('rollbacks', 0)} "
                  "rollback(s)")
+    gameday = status.get("gameday")
+    if gameday:
+        tail += "\n\ngame day:"
+        act = gameday.get("active")
+        if act:
+            tail += (f"\n  ACTIVE {act['scenario']} "
+                     f"(expect {act.get('expect')}, "
+                     f"digest {act.get('schedule_digest')}): "
+                     f"phase={act.get('phase')}")
+            if act.get("offered") is not None:
+                tail += (f"  offered={act['offered']} "
+                         f"served={act.get('ok')}"
+                         f" ({act.get('served_frac')})")
+        for fin in gameday.get("finished", []):
+            mark = "PASS" if fin.get("passed") else "FAIL"
+            expect = ("as expected" if fin.get("ok_as_expected")
+                      else "NOT as expected")
+            tail += (f"\n  {fin['scenario']}: {mark} "
+                     f"(expect {fin.get('expect')}, {expect}, "
+                     f"{fin.get('elapsed_s')}s, "
+                     f"digest {fin.get('schedule_digest')})")
+        for k in gameday.get("kills", []):
+            tail += (f"\n  kill: {k['scenario']} SIGKILLed "
+                     f"{k.get('replica')} (pid {k.get('victim_pid')})")
+        n_total = gameday.get("verdict_total", 0)
+        shown = gameday.get("verdicts", [])
+        if shown:
+            tail += f"\n  last {len(shown)} of {n_total} verdict row(s):"
+            for v in shown:
+                tail += (f"\n    {v['scenario']} :: {v['predicate']}: "
+                         f"{'ok' if v.get('ok') else 'FAIL'}")
     return "\n".join(lines) + "\n" + tail
 
 

@@ -20,7 +20,10 @@ chrome://tracing both load):
   renders fold k's search visibly overlapping fold k+1's training;
 - everything else (``shed``, ``breaker_fire``, ``watchdog_fire``,
   ``lease``, ``trial``, ``checkpoint``, ``reload``, ``preempt``,
-  ``mark``) becomes an INSTANT ("i") marker.
+  ``scenario``, ``verdict``, ``mark``) becomes an INSTANT ("i")
+  marker — so a game-day run (docs/GAMEDAYS.md) shows its scenario
+  phases, kills and verdict rows on the same timeline as the plane's
+  dispatches, sheds and scale events.
 
 Clock alignment: monotonic stamps are consistent only within a
 process, so each record's own ``(t_wall, t_mono)`` pair (taken at emit)
